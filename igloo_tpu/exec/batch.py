@@ -1,0 +1,344 @@
+"""DeviceBatch: the TPU-resident columnar batch.
+
+This is the engine's universal data representation on device, playing the role Arrow
+`RecordBatch` plays in the reference (reference crates/engine/src/physical_plan.rs:10-17
+streams RecordBatch between operators). Design differences are deliberate TPU choices:
+
+- **Static shapes.** Every column is padded to a power-of-two `capacity`; a `live`
+  boolean lane marks real rows. Filters do not compact (the reference's FilterExec
+  eagerly materializes filtered batches, crates/engine/src/operators/filter.rs:39-68);
+  we AND into the selection mask so downstream ops fuse into one XLA computation with
+  no dynamic shapes. Compaction happens only where required (joins, shuffles, output),
+  via a stable sort on the mask — still static-shaped.
+
+- **Strings never touch HBM.** String columns are dictionary-encoded at scan time with
+  a per-table, lexicographically SORTED, unified dictionary; the device sees int32 ids.
+  Because the dictionary is sorted, ORDER BY / MIN / MAX / range predicates work
+  directly on ids; equality/LIKE/functions evaluate host-side over the (small)
+  dictionary and become id-lookups on device. Cross-table string comparisons (join
+  keys) go through per-entry 64-bit hashes (see `DictInfo.hashes`).
+
+- **Nulls are a separate bool lane** (True = null), mirroring Arrow validity bitmaps
+  but kept as full bool lanes for VPU-friendly masking.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from igloo_tpu.types import (
+    BOOL, DATE32, FLOAT32, FLOAT64, INT32, INT64, STRING, TIMESTAMP,
+    DataType, Field, Schema, TypeId,
+)
+
+MIN_CAPACITY = 8
+
+
+def round_capacity(n: int) -> int:
+    """Pad row counts to power-of-two buckets so XLA recompiles rarely (shape bucketing;
+    cf. SURVEY.md §7 hard part 5)."""
+    c = MIN_CAPACITY
+    while c < n:
+        c <<= 1
+    return c
+
+
+# 64-bit mixing constants (splitmix64 finalizer) used for dictionary/string hashing.
+_SM64_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM64_C2 = np.uint64(0x94D049BB133111EB)
+
+
+def hash64_bytes(values: Sequence[object], seed: int = 0) -> np.ndarray:
+    """Host-side 64-bit FNV-1a + splitmix64-finalized hash of string values
+    (dictionary entries). Vectorized over entries: the python-level loop is over the
+    max string LENGTH, not over entries×bytes, so high-cardinality dictionaries
+    (e.g. TPC-H comment columns) hash at numpy speed. A C++ fast path may override
+    this via igloo_tpu.native (same algorithm, same results)."""
+    n = len(values)
+    if n == 0:
+        return np.empty(0, dtype=np.uint64)
+    bufs = [(v.encode("utf-8") if isinstance(v, str) else bytes(v)) if v is not None else None
+            for v in values]
+    lengths = np.asarray([len(b) if b is not None else 0 for b in bufs], dtype=np.int64)
+    none_mask = np.asarray([b is None for b in bufs], dtype=bool)
+    max_len = int(lengths.max()) if n else 0
+    mat = np.zeros((n, max_len), dtype=np.uint64)
+    if max_len:
+        flat = np.frombuffer(b"".join(b for b in bufs if b is not None), dtype=np.uint8)
+        starts = np.zeros(n, dtype=np.int64)
+        np.cumsum(lengths[:-1], out=starts[1:])
+        rows, cols = np.nonzero(np.arange(max_len)[None, :] < lengths[:, None])
+        mat[rows, cols] = flat[starts[rows] + cols]
+    with np.errstate(over="ignore"):
+        h = np.full(n, np.uint64(seed) + np.uint64(0x9E3779B97F4A7C15), dtype=np.uint64)
+        prime = np.uint64(0x100000001B3)
+        for j in range(max_len):
+            active = j < lengths
+            nh = (h ^ mat[:, j]) * prime
+            h = np.where(active, nh, h)
+        # splitmix64 finalize
+        h ^= h >> np.uint64(30)
+        h *= _SM64_C1
+        h ^= h >> np.uint64(27)
+        h *= _SM64_C2
+        h ^= h >> np.uint64(31)
+        h[none_mask] = np.uint64(seed) ^ np.uint64(0x9E3779B97F4A7C15)
+    return h
+
+
+@dataclass(frozen=True)
+class DictInfo:
+    """Host-side dictionary for a STRING column.
+
+    values:  np object array of python strings, lexicographically sorted.
+    hashes:  uint64[len] per-entry hash (seed 0)   — device-gatherable for join keys.
+    hashes2: uint64[len] independent hash (seed 1) — collision guard (128-bit effective).
+    """
+    values: np.ndarray
+    hashes: np.ndarray
+    hashes2: np.ndarray
+
+    @staticmethod
+    def from_values(values: Sequence[object]) -> "DictInfo":
+        arr = np.asarray(list(values), dtype=object)
+        return DictInfo(arr, hash64_bytes(arr, seed=0), hash64_bytes(arr, seed=1))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+
+@dataclass
+class DeviceColumn:
+    """One column: a padded device lane + optional null lane + host dictionary."""
+    dtype: DataType
+    values: jax.Array              # [capacity], device dtype per DataType.device_dtype
+    nulls: Optional[jax.Array]     # [capacity] bool, True = null; None = no nulls
+    dictionary: Optional[DictInfo] = None  # STRING columns only
+
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[0]
+
+    def with_nulls(self, nulls: Optional[jax.Array]) -> "DeviceColumn":
+        return replace(self, nulls=nulls)
+
+
+@dataclass
+class DeviceBatch:
+    """A batch of rows resident in device memory (HBM)."""
+    schema: Schema
+    columns: list[DeviceColumn]
+    live: jax.Array                # [capacity] bool selection mask
+
+    @property
+    def capacity(self) -> int:
+        return int(self.live.shape[0])
+
+    def column(self, name: str) -> DeviceColumn:
+        return self.columns[self.schema.index_of(name)]
+
+    def num_live(self) -> int:
+        """Host sync: count of selected rows."""
+        return int(jnp.sum(self.live))
+
+    def nbytes(self) -> int:
+        total = self.live.nbytes
+        for c in self.columns:
+            total += c.values.nbytes
+            if c.nulls is not None:
+                total += c.nulls.nbytes
+        return total
+
+    # ---- construction -------------------------------------------------------
+
+    @staticmethod
+    def empty(schema: Schema, capacity: int = MIN_CAPACITY) -> "DeviceBatch":
+        cols = []
+        for f in schema:
+            vals = jnp.zeros((capacity,), dtype=f.dtype.device_dtype())
+            cols.append(DeviceColumn(f.dtype, vals, None,
+                                     DictInfo.from_values([]) if f.dtype.is_string else None))
+        return DeviceBatch(schema, cols, jnp.zeros((capacity,), dtype=bool))
+
+
+# ---------------------------------------------------------------------------
+# Arrow <-> device conversion (the host/HBM boundary; replaces the reference's
+# in-process RecordBatch streaming, crates/engine/src/operators/parquet_scan.rs:40-85)
+# ---------------------------------------------------------------------------
+
+_ARROW_TO_TYPE = {
+    pa.bool_(): BOOL,
+    pa.int8(): INT32, pa.int16(): INT32, pa.int32(): INT32,
+    pa.uint8(): INT32, pa.uint16(): INT32,
+    pa.int64(): INT64, pa.uint32(): INT64, pa.uint64(): INT64,
+    pa.float32(): FLOAT32,
+    pa.float64(): FLOAT64,
+    pa.date32(): DATE32,
+    pa.string(): STRING, pa.large_string(): STRING, pa.utf8(): STRING,
+}
+
+
+def arrow_type_to_dtype(t: pa.DataType) -> DataType:
+    if t in _ARROW_TO_TYPE:
+        return _ARROW_TO_TYPE[t]
+    if pa.types.is_timestamp(t):
+        return TIMESTAMP
+    if pa.types.is_decimal(t):
+        return FLOAT64  # TPC-H decimals computed in float64 on device
+    if pa.types.is_dictionary(t):
+        return arrow_type_to_dtype(t.value_type)
+    if pa.types.is_date64(t):
+        return DATE32
+    raise TypeError(f"unsupported arrow type {t}")
+
+
+def schema_from_arrow(s: pa.Schema) -> Schema:
+    return Schema([Field(f.name, arrow_type_to_dtype(f.type), f.nullable) for f in s])
+
+
+def dtype_to_arrow(d: DataType) -> pa.DataType:
+    return {
+        TypeId.BOOL: pa.bool_(), TypeId.INT32: pa.int32(), TypeId.INT64: pa.int64(),
+        TypeId.FLOAT32: pa.float32(), TypeId.FLOAT64: pa.float64(),
+        TypeId.STRING: pa.string(), TypeId.DATE32: pa.date32(),
+        TypeId.TIMESTAMP: pa.timestamp("us"), TypeId.NULL: pa.int32(),
+    }[d.id]
+
+
+def _encode_string_column(arr: pa.ChunkedArray, dict_info: Optional[DictInfo]):
+    """Dictionary-encode with a sorted dictionary. If `dict_info` is given, ids are
+    assigned against it (table-unified dictionary); values absent from it are an error
+    (scan builds the union up front)."""
+    combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    if pa.types.is_dictionary(combined.type):
+        combined = combined.cast(pa.string()) if not pa.types.is_large_string(combined.type.value_type) else combined.cast(pa.large_string())
+    np_vals = combined.to_numpy(zero_copy_only=False)
+    null_mask = np.asarray([v is None for v in np_vals]) if combined.null_count else None
+    if dict_info is None:
+        uniq = sorted({v for v in np_vals if v is not None})
+        dict_info = DictInfo.from_values(uniq)
+    # searchsorted against the sorted dictionary gives ids == lexicographic ranks
+    safe = np.asarray(["" if v is None else v for v in np_vals], dtype=object)
+    if len(dict_info) == 0:
+        if len(np_vals) and not all(v is None for v in np_vals):
+            raise ValueError("string values present but unified dictionary is empty")
+        ids = np.zeros(len(np_vals), dtype=np.int32)
+    else:
+        dstr = dict_info.values.astype(str)
+        ids = np.searchsorted(dstr, safe.astype(str)).astype(np.int32)
+        ids = np.clip(ids, 0, len(dict_info) - 1)
+        ok = dstr[ids] == safe.astype(str)
+        if null_mask is not None:
+            ok = ok | null_mask
+        if not ok.all():
+            missing = sorted({str(v) for v, o in zip(safe, ok) if not o})[:5]
+            raise ValueError(f"string values not in unified dictionary: {missing}")
+    return ids, null_mask, dict_info
+
+
+def _arrow_column_to_numpy(arr: pa.ChunkedArray, dtype: DataType):
+    combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    if pa.types.is_decimal(combined.type):
+        combined = combined.cast(pa.float64())
+    if pa.types.is_timestamp(combined.type):
+        combined = combined.cast(pa.timestamp("us"))
+    null_mask = None
+    if combined.null_count:
+        null_mask = np.asarray(pa.compute.is_null(combined).to_numpy(zero_copy_only=False))
+        fill = False if dtype.id == TypeId.BOOL else (0.0 if dtype.is_float else 0)
+        combined = pa.compute.fill_null(combined, fill)
+    np_vals = combined.to_numpy(zero_copy_only=False)
+    np_vals = np.asarray(np_vals).astype(dtype.device_dtype(), copy=False)
+    return np_vals, null_mask
+
+
+def _pad(a: np.ndarray, capacity: int) -> np.ndarray:
+    if len(a) == capacity:
+        return a
+    out = np.zeros((capacity,), dtype=a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+def from_arrow(
+    table: pa.Table,
+    schema: Optional[Schema] = None,
+    capacity: Optional[int] = None,
+    dictionaries: Optional[dict[str, DictInfo]] = None,
+    device=None,
+) -> DeviceBatch:
+    """pyarrow Table -> DeviceBatch (host decode -> device_put into HBM)."""
+    if schema is None:
+        schema = schema_from_arrow(table.schema)
+    n = table.num_rows
+    cap = capacity or round_capacity(n)
+    cols: list[DeviceColumn] = []
+    for f in schema:
+        arr = table.column(f.name)
+        if f.dtype.is_string:
+            pre = dictionaries.get(f.name) if dictionaries else None
+            ids, null_mask, dinfo = _encode_string_column(arr, pre)
+            vals = _pad(ids, cap)
+            dev_vals = jnp.asarray(vals) if device is None else jax.device_put(vals, device)
+            nulls = None
+            if null_mask is not None:
+                nm = _pad(null_mask, cap)
+                nulls = jnp.asarray(nm) if device is None else jax.device_put(nm, device)
+            cols.append(DeviceColumn(f.dtype, dev_vals, nulls, dinfo))
+        else:
+            np_vals, null_mask = _arrow_column_to_numpy(arr, f.dtype)
+            vals = _pad(np_vals, cap)
+            dev_vals = jnp.asarray(vals) if device is None else jax.device_put(vals, device)
+            nulls = None
+            if null_mask is not None:
+                nm = _pad(null_mask, cap)
+                nulls = jnp.asarray(nm) if device is None else jax.device_put(nm, device)
+            cols.append(DeviceColumn(f.dtype, dev_vals, nulls, None))
+    live = np.zeros((cap,), dtype=bool)
+    live[:n] = True
+    live_dev = jnp.asarray(live) if device is None else jax.device_put(live, device)
+    return DeviceBatch(schema, cols, live_dev)
+
+
+def to_arrow(batch: DeviceBatch) -> pa.Table:
+    """DeviceBatch -> pyarrow Table on host, dropping dead lanes, decoding dictionaries,
+    re-applying null masks. Order of surviving rows is preserved."""
+    live = np.asarray(batch.live)
+    idx = np.nonzero(live)[0]
+    arrays, fields = [], []
+    for f, c in zip(batch.schema, batch.columns):
+        vals = np.asarray(c.values)[idx]
+        nulls = np.asarray(c.nulls)[idx] if c.nulls is not None else None
+        if f.dtype.is_string:
+            d = c.dictionary.values if c.dictionary is not None and len(c.dictionary) else np.asarray([], dtype=object)
+            if len(d):
+                ids = np.clip(vals, 0, len(d) - 1)
+                py = d[ids]
+            else:
+                py = np.asarray([""] * len(vals), dtype=object)
+            if nulls is not None:
+                py = py.copy()
+                py[nulls] = None
+            arrays.append(pa.array(py, type=pa.string()))
+        elif f.dtype.id == TypeId.DATE32:
+            a = pa.array(vals.astype("int32"), type=pa.int32()).cast(pa.date32())
+            if nulls is not None:
+                a = pa.compute.if_else(pa.array(~nulls), a, pa.scalar(None, type=pa.date32()))
+            arrays.append(a)
+        elif f.dtype.id == TypeId.TIMESTAMP:
+            a = pa.array(vals.astype("int64"), type=pa.int64()).cast(pa.timestamp("us"))
+            if nulls is not None:
+                a = pa.compute.if_else(pa.array(~nulls), a, pa.scalar(None, type=pa.timestamp("us")))
+            arrays.append(a)
+        else:
+            if nulls is not None:
+                arrays.append(pa.array(vals, mask=nulls))
+            else:
+                arrays.append(pa.array(vals))
+        fields.append(pa.field(f.name, arrays[-1].type, f.nullable))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))

@@ -1,0 +1,333 @@
+"""Expression IR.
+
+The reference delegates expression representation to DataFusion's `PhysicalExpr`
+(crates/engine/src/operators/projection.rs:12-16, filter.rs:13-16 hold
+`Arc<dyn PhysicalExpr>`); we own the IR because it must lower to jnp element-wise
+graphs fused into each fragment's jit function (SURVEY.md §2 #7 "expression compiler").
+
+Expressions are built untyped by the SQL parser, then *bound* (names resolved, types
+inferred) by the planner. `dtype` is filled in during binding.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+from igloo_tpu import types as T
+
+
+class BinOp(enum.Enum):
+    ADD = "+"
+    SUB = "-"
+    MUL = "*"
+    DIV = "/"
+    MOD = "%"
+    EQ = "="
+    NEQ = "<>"
+    LT = "<"
+    LTE = "<="
+    GT = ">"
+    GTE = ">="
+    AND = "and"
+    OR = "or"
+
+
+COMPARISONS = {BinOp.EQ, BinOp.NEQ, BinOp.LT, BinOp.LTE, BinOp.GT, BinOp.GTE}
+ARITHMETIC = {BinOp.ADD, BinOp.SUB, BinOp.MUL, BinOp.DIV, BinOp.MOD}
+
+
+@dataclass
+class Expr:
+    """Base expression node. `dtype` is None until bound."""
+    dtype: Optional[T.DataType] = dc_field(default=None, init=False, compare=False)
+
+    def name_hint(self) -> str:
+        return "expr"
+
+    def children(self) -> list["Expr"]:
+        return []
+
+
+@dataclass
+class Column(Expr):
+    name: str
+    # Resolved during binding: index into the input schema.
+    index: Optional[int] = dc_field(default=None, compare=False)
+
+    def name_hint(self) -> str:
+        return self.name.split(".")[-1]
+
+    def __repr__(self) -> str:
+        return f"col({self.name})"
+
+
+@dataclass
+class Literal(Expr):
+    value: object  # python int/float/str/bool/None; dates as int days, ts as int us
+    literal_type: Optional[T.DataType] = None
+
+    def name_hint(self) -> str:
+        return str(self.value)
+
+    def __repr__(self) -> str:
+        return f"lit({self.value!r})"
+
+
+@dataclass
+class Interval(Expr):
+    """INTERVAL literal; exists only pre-folding (date arithmetic constant-folds)."""
+    days: int = 0
+    months: int = 0
+
+    def __repr__(self) -> str:
+        return f"interval(days={self.days}, months={self.months})"
+
+
+@dataclass
+class Binary(Expr):
+    op: BinOp
+    left: Expr
+    right: Expr
+
+    def children(self):
+        return [self.left, self.right]
+
+    def name_hint(self) -> str:
+        return f"{self.left.name_hint()} {self.op.value} {self.right.name_hint()}"
+
+    def __repr__(self) -> str:
+        return f"({self.left!r} {self.op.value} {self.right!r})"
+
+
+@dataclass
+class Not(Expr):
+    operand: Expr
+
+    def children(self):
+        return [self.operand]
+
+    def __repr__(self) -> str:
+        return f"not({self.operand!r})"
+
+
+@dataclass
+class Negate(Expr):
+    operand: Expr
+
+    def children(self):
+        return [self.operand]
+
+    def __repr__(self) -> str:
+        return f"(-{self.operand!r})"
+
+
+@dataclass
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+    def children(self):
+        return [self.operand]
+
+    def __repr__(self) -> str:
+        return f"is_{'not_' if self.negated else ''}null({self.operand!r})"
+
+
+@dataclass
+class Cast(Expr):
+    operand: Expr
+    to: T.DataType = None  # type: ignore[assignment]
+
+    def children(self):
+        return [self.operand]
+
+    def name_hint(self) -> str:
+        return self.operand.name_hint()
+
+    def __repr__(self) -> str:
+        return f"cast({self.operand!r} as {self.to})"
+
+
+@dataclass
+class Case(Expr):
+    """CASE WHEN c THEN v ... ELSE e END (searched form; simple form is desugared)."""
+    whens: list[tuple[Expr, Expr]] = dc_field(default_factory=list)
+    else_: Optional[Expr] = None
+
+    def children(self):
+        out = []
+        for c, v in self.whens:
+            out += [c, v]
+        if self.else_ is not None:
+            out.append(self.else_)
+        return out
+
+    def __repr__(self) -> str:
+        return f"case({self.whens!r}, else={self.else_!r})"
+
+
+@dataclass
+class InList(Expr):
+    operand: Expr
+    items: list[Expr] = dc_field(default_factory=list)
+    negated: bool = False
+
+    def children(self):
+        return [self.operand] + self.items
+
+    def __repr__(self) -> str:
+        return f"in({self.operand!r}, {self.items!r}, neg={self.negated})"
+
+
+@dataclass
+class Like(Expr):
+    operand: Expr
+    pattern: str = ""
+    negated: bool = False
+    case_insensitive: bool = False
+
+    def children(self):
+        return [self.operand]
+
+    def __repr__(self) -> str:
+        return f"like({self.operand!r}, {self.pattern!r})"
+
+
+@dataclass
+class Func(Expr):
+    """Scalar function call: abs, upper, lower, capitalize, length, substr, concat,
+    extract_year/month/day, coalesce, round, floor, ceil, sqrt, ..."""
+    name: str = ""
+    args: list[Expr] = dc_field(default_factory=list)
+
+    def children(self):
+        return self.args
+
+    def name_hint(self) -> str:
+        return self.name
+
+    def __repr__(self) -> str:
+        return f"{self.name}({self.args!r})"
+
+
+class AggFunc(enum.Enum):
+    SUM = "sum"
+    COUNT = "count"
+    MIN = "min"
+    MAX = "max"
+    AVG = "avg"
+    COUNT_STAR = "count_star"
+
+
+@dataclass
+class Aggregate(Expr):
+    """Aggregate function reference inside a SELECT/HAVING. The planner hoists these
+    into the Aggregate plan node; they never reach the expression compiler directly."""
+    func: AggFunc = AggFunc.COUNT_STAR
+    arg: Optional[Expr] = None
+    distinct: bool = False
+
+    def children(self):
+        return [self.arg] if self.arg is not None else []
+
+    def name_hint(self) -> str:
+        if self.func is AggFunc.COUNT_STAR:
+            return "count(*)"
+        return f"{self.func.value}({self.arg.name_hint()})"
+
+    def __repr__(self) -> str:
+        return f"{self.func.value}({self.arg!r}{', distinct' if self.distinct else ''})"
+
+
+@dataclass
+class Alias(Expr):
+    operand: Expr = None  # type: ignore[assignment]
+    alias: str = ""
+
+    def children(self):
+        return [self.operand]
+
+    def name_hint(self) -> str:
+        return self.alias
+
+    def __repr__(self) -> str:
+        return f"({self.operand!r} as {self.alias})"
+
+
+@dataclass
+class Star(Expr):
+    """SELECT * placeholder; expanded by the planner."""
+    qualifier: Optional[str] = None
+
+    def __repr__(self) -> str:
+        return f"{self.qualifier + '.' if self.qualifier else ''}*"
+
+
+@dataclass
+class ScalarSubquery(Expr):
+    """(SELECT single value); the planner evaluates uncorrelated ones eagerly."""
+    query: object = None  # ast.SelectStmt (avoid circular import)
+
+    def __repr__(self) -> str:
+        return "scalar_subquery(...)"
+
+
+@dataclass
+class InSubquery(Expr):
+    operand: Expr = None  # type: ignore[assignment]
+    query: object = None
+    negated: bool = False
+
+    def children(self):
+        return [self.operand]
+
+    def __repr__(self) -> str:
+        return f"in_subquery({self.operand!r}, neg={self.negated})"
+
+
+@dataclass
+class Exists(Expr):
+    query: object = None
+    negated: bool = False
+
+    def __repr__(self) -> str:
+        return f"exists(neg={self.negated})"
+
+
+def walk(e: Expr):
+    yield e
+    for c in e.children():
+        yield from walk(c)
+
+
+def transform(e: Expr, fn) -> Expr:
+    """Bottom-up rewrite: fn applied to each node after its children are rewritten."""
+    import copy
+    n = copy.copy(e)
+    if isinstance(n, Binary):
+        n.left = transform(n.left, fn)
+        n.right = transform(n.right, fn)
+    elif isinstance(n, (Not, Negate, IsNull, Cast)):
+        n.operand = transform(n.operand, fn)
+    elif isinstance(n, Case):
+        n.whens = [(transform(c, fn), transform(v, fn)) for c, v in n.whens]
+        n.else_ = transform(n.else_, fn) if n.else_ is not None else None
+    elif isinstance(n, InList):
+        n.operand = transform(n.operand, fn)
+        n.items = [transform(i, fn) for i in n.items]
+    elif isinstance(n, Like):
+        n.operand = transform(n.operand, fn)
+    elif isinstance(n, Func):
+        n.args = [transform(a, fn) for a in n.args]
+    elif isinstance(n, Aggregate):
+        n.arg = transform(n.arg, fn) if n.arg is not None else None
+    elif isinstance(n, Alias):
+        n.operand = transform(n.operand, fn)
+    elif isinstance(n, InSubquery):
+        n.operand = transform(n.operand, fn)
+    return fn(n)
+
+
+def columns_in(e: Expr) -> set[str]:
+    return {n.name for n in walk(e) if isinstance(n, Column)}

@@ -1,0 +1,157 @@
+"""Logical type system for igloo-tpu.
+
+The reference engine uses Arrow's type system throughout (RecordBatch is the universal
+data representation — see reference crates/engine/src/physical_plan.rs:10-17). We keep
+Arrow at the host edges but narrow the *device* representation to types the TPU handles
+natively:
+
+- integers      -> int32 / int64 lanes
+- floats        -> float32 / float64 lanes (TPC-H decimals are computed as float64)
+- bool          -> bool lanes
+- date32        -> int32 days-since-epoch
+- timestamp     -> int64 micros
+- string        -> dictionary-encoded int32 ids; the dictionary itself stays host-side
+                   (strings never touch HBM — string functions run over the small
+                   dictionary on host, comparisons become id-set membership on device)
+
+Every column carries an optional validity (null) mask as a separate bool lane.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class TypeId(enum.Enum):
+    BOOL = "bool"
+    INT32 = "int32"
+    INT64 = "int64"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    STRING = "string"       # device repr: int32 dictionary ids
+    DATE32 = "date32"       # device repr: int32 days since epoch
+    TIMESTAMP = "timestamp"  # device repr: int64 microseconds since epoch
+    NULL = "null"
+
+
+@dataclass(frozen=True)
+class DataType:
+    id: TypeId
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.id in (TypeId.INT32, TypeId.INT64, TypeId.FLOAT32, TypeId.FLOAT64)
+
+    @property
+    def is_integer(self) -> bool:
+        return self.id in (TypeId.INT32, TypeId.INT64)
+
+    @property
+    def is_float(self) -> bool:
+        return self.id in (TypeId.FLOAT32, TypeId.FLOAT64)
+
+    @property
+    def is_string(self) -> bool:
+        return self.id == TypeId.STRING
+
+    @property
+    def is_temporal(self) -> bool:
+        return self.id in (TypeId.DATE32, TypeId.TIMESTAMP)
+
+    def device_dtype(self) -> np.dtype:
+        """numpy dtype of the on-device lane for this logical type."""
+        return np.dtype(_DEVICE_DTYPE[self.id])
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return self.id.value
+
+
+BOOL = DataType(TypeId.BOOL)
+INT32 = DataType(TypeId.INT32)
+INT64 = DataType(TypeId.INT64)
+FLOAT32 = DataType(TypeId.FLOAT32)
+FLOAT64 = DataType(TypeId.FLOAT64)
+STRING = DataType(TypeId.STRING)
+DATE32 = DataType(TypeId.DATE32)
+TIMESTAMP = DataType(TypeId.TIMESTAMP)
+NULL = DataType(TypeId.NULL)
+
+_DEVICE_DTYPE = {
+    TypeId.BOOL: "bool",
+    TypeId.INT32: "int32",
+    TypeId.INT64: "int64",
+    TypeId.FLOAT32: "float32",
+    TypeId.FLOAT64: "float64",
+    TypeId.STRING: "int32",
+    TypeId.DATE32: "int32",
+    TypeId.TIMESTAMP: "int64",
+    TypeId.NULL: "int32",
+}
+
+_NUMERIC_RANK = {TypeId.BOOL: 0, TypeId.INT32: 1, TypeId.INT64: 2, TypeId.FLOAT32: 3, TypeId.FLOAT64: 4}
+
+
+def common_type(a: DataType, b: DataType) -> DataType:
+    """Binary-op result type (SQL-ish numeric promotion)."""
+    if a == b:
+        return a
+    if a.id == TypeId.NULL:
+        return b
+    if b.id == TypeId.NULL:
+        return a
+    if a.id in _NUMERIC_RANK and b.id in _NUMERIC_RANK:
+        ra, rb = _NUMERIC_RANK[a.id], _NUMERIC_RANK[b.id]
+        # int64 (+) float32 -> float64 to avoid precision loss
+        if {a.id, b.id} == {TypeId.INT64, TypeId.FLOAT32}:
+            return FLOAT64
+        return a if ra >= rb else b
+    if a.is_temporal and b.is_temporal:
+        return TIMESTAMP
+    if (a.id == TypeId.DATE32 and b.is_integer) or (b.id == TypeId.DATE32 and a.is_integer):
+        return DATE32  # date +/- int days
+    raise TypeError(f"no common type for {a} and {b}")
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+
+class Schema:
+    """Ordered, named, typed columns. Mirrors Arrow's Schema but engine-owned."""
+
+    def __init__(self, fields: list[Field]):
+        self.fields = list(fields)
+        self._index: dict[str, int] = {}
+        for i, f in enumerate(self.fields):
+            # last-wins on duplicate names (SQL allows dup output names)
+            self._index[f.name] = i
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        return self.fields[self._index[name]]
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return "Schema(" + ", ".join(f"{f.name}: {f.dtype}" for f in self.fields) + ")"

@@ -1,0 +1,14 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh so
+sharding/shuffle paths execute in CI without TPUs (SURVEY.md §4 test strategy (b);
+the reference has no distributed tests at all — we invent the strategy here)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,96 @@
+"""DeviceBatch round-trip tests: Arrow -> HBM lanes -> Arrow.
+
+Mirrors the role of the reference's engine inline tests (crates/engine/src/lib.rs:146-231)
+at the layer below: the data representation itself."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from igloo_tpu.exec import batch as B
+from igloo_tpu import types as T
+
+
+def test_round_capacity():
+    assert B.round_capacity(0) == 8
+    assert B.round_capacity(8) == 8
+    assert B.round_capacity(9) == 16
+    assert B.round_capacity(1000) == 1024
+
+
+def test_numeric_round_trip():
+    t = pa.table({
+        "a": pa.array([1, 2, 3], type=pa.int64()),
+        "b": pa.array([1.5, 2.5, None], type=pa.float64()),
+        "c": pa.array([True, False, True]),
+    })
+    db = B.from_arrow(t)
+    assert db.capacity == 8
+    assert db.num_live() == 3
+    out = B.to_arrow(db)
+    assert out.column("a").to_pylist() == [1, 2, 3]
+    assert out.column("b").to_pylist() == [1.5, 2.5, None]
+    assert out.column("c").to_pylist() == [True, False, True]
+
+
+def test_string_dictionary_sorted():
+    t = pa.table({"s": pa.array(["banana", "apple", "cherry", "apple", None])})
+    db = B.from_arrow(t)
+    col = db.column("s")
+    assert col.dictionary is not None
+    assert list(col.dictionary.values) == ["apple", "banana", "cherry"]
+    ids = np.asarray(col.values)[:5]
+    assert list(ids[:4]) == [1, 0, 2, 0]  # lexicographic ranks
+    out = B.to_arrow(db)
+    assert out.column("s").to_pylist() == ["banana", "apple", "cherry", "apple", None]
+
+
+def test_date_and_timestamp_round_trip():
+    import datetime
+    t = pa.table({
+        "d": pa.array([datetime.date(1994, 1, 1), datetime.date(1998, 12, 1)], type=pa.date32()),
+        "ts": pa.array([datetime.datetime(2020, 1, 2, 3, 4, 5)], type=pa.timestamp("us")).take([0, 0]),
+    })
+    db = B.from_arrow(t)
+    assert db.schema.field("d").dtype == T.DATE32
+    assert db.schema.field("ts").dtype == T.TIMESTAMP
+    out = B.to_arrow(db)
+    assert out.column("d").to_pylist() == [datetime.date(1994, 1, 1), datetime.date(1998, 12, 1)]
+    assert out.column("ts").to_pylist()[0] == datetime.datetime(2020, 1, 2, 3, 4, 5)
+
+
+def test_decimal_becomes_float64():
+    t = pa.table({"p": pa.array([1, 2], type=pa.decimal128(12, 2)).cast(pa.decimal128(12, 2))})
+    db = B.from_arrow(t)
+    assert db.schema.field("p").dtype == T.FLOAT64
+
+
+def test_unified_dictionary_across_batches():
+    d = B.DictInfo.from_values(["a", "b", "c"])
+    t = pa.table({"s": pa.array(["c", "a"])})
+    db = B.from_arrow(t, dictionaries={"s": d})
+    assert list(np.asarray(db.column("s").values)[:2]) == [2, 0]
+
+
+def test_hash64_distinct():
+    h = B.hash64_bytes(["a", "b", "ab", "ba", ""])
+    assert len(set(h.tolist())) == 5
+
+
+def test_nullable_bool_round_trip():
+    t = pa.table({"c": pa.array([True, None, False])})
+    db = B.from_arrow(t)
+    assert B.to_arrow(db).column("c").to_pylist() == [True, None, False]
+
+
+def test_dictionary_mismatch_raises():
+    d = B.DictInfo.from_values(["apple", "cherry"])
+    t = pa.table({"s": pa.array(["banana", "apple"])})
+    with pytest.raises(ValueError, match="not in unified dictionary"):
+        B.from_arrow(t, dictionaries={"s": d})
+
+
+def test_hash64_vectorized_matches_none_and_empty():
+    h = B.hash64_bytes(["", None, "x"])
+    assert h[0] != h[1] and h[1] != h[2]
+    h2 = B.hash64_bytes(["", None, "x"])
+    assert (h == h2).all()

@@ -1,0 +1,218 @@
+"""Expression compiler tests: IR -> jnp, SQL null semantics, string dictionary tricks."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from igloo_tpu import types as T
+from igloo_tpu.exec import batch as B
+from igloo_tpu.exec.expr_compile import Env, ExprCompiler
+from igloo_tpu.plan import expr as E
+
+
+def make_batch():
+    t = pa.table({
+        "a": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "b": pa.array([10.0, None, 30.0, 40.0], type=pa.float64()),
+        "s": pa.array(["foo", "bar", "FOO", None]),
+        "d": pa.array([8766, 9131, 10000, 10592], type=pa.int32()).cast(pa.date32()),
+    })
+    return B.from_arrow(t)
+
+
+def col(name, batch, dtype):
+    c = E.Column(name)
+    c.index = batch.schema.index_of(name)
+    c.dtype = dtype
+    return c
+
+
+def lit(v, dtype):
+    l = E.Literal(v, dtype)
+    l.dtype = dtype
+    return l
+
+
+def run(expr, batch):
+    comp = ExprCompiler.for_batch(batch).compile(expr)
+    vals, nulls = comp.fn(Env.from_batch(batch))
+    live = np.asarray(batch.live)
+    v = np.asarray(vals)[live]
+    n = np.asarray(nulls)[live] if nulls is not None else np.zeros(len(v), bool)
+    return v, n, comp
+
+
+def test_arithmetic_with_nulls():
+    b = make_batch()
+    e = E.Binary(E.BinOp.ADD, col("a", b, T.INT64), col("b", b, T.FLOAT64))
+    e.dtype = T.FLOAT64
+    v, n, _ = run(e, b)
+    assert v[0] == 11.0 and v[2] == 33.0
+    assert list(n) == [False, True, False, False]
+
+
+def test_comparison_and_kleene_and():
+    b = make_batch()
+    cmp1 = E.Binary(E.BinOp.GT, col("a", b, T.INT64), lit(1, T.INT64))
+    cmp1.dtype = T.BOOL
+    cmp2 = E.Binary(E.BinOp.LT, col("b", b, T.FLOAT64), lit(35.0, T.FLOAT64))
+    cmp2.dtype = T.BOOL
+    e = E.Binary(E.BinOp.AND, cmp1, cmp2)
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    # row0: a>1 F -> F (definite); row1: T AND NULL -> NULL; row2: T&T; row3: T&F
+    assert list(v & ~n) == [False, False, True, False]
+    assert list(n) == [False, True, False, False]
+
+
+def test_div_by_zero_is_null():
+    b = make_batch()
+    e = E.Binary(E.BinOp.DIV, col("a", b, T.INT64), lit(0, T.INT64))
+    e.dtype = T.INT64
+    v, n, _ = run(e, b)
+    assert all(n)
+
+
+def test_string_eq_literal():
+    b = make_batch()
+    e = E.Binary(E.BinOp.EQ, col("s", b, T.STRING), lit("foo", T.STRING))
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    assert list(v[:3]) == [True, False, False]
+    assert list(n) == [False, False, False, True]
+
+
+def test_like():
+    b = make_batch()
+    e = E.Like(col("s", b, T.STRING), "%o")
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    assert list(v[:3]) == [True, False, False]  # FOO ends in O not o
+
+
+def test_upper_then_eq():
+    b = make_batch()
+    up = E.Func("upper", [col("s", b, T.STRING)])
+    up.dtype = T.STRING
+    e = E.Binary(E.BinOp.EQ, up, lit("FOO", T.STRING))
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    assert list(v[:3]) == [True, False, True]
+
+
+def test_capitalize_matches_reference_udf():
+    # parity: reference capitalize UDF (crates/engine/src/lib.rs:71-95)
+    t = pa.table({"s": pa.array(["hello", "wORLD", ""])})
+    b = B.from_arrow(t)
+    e = E.Func("capitalize", [col("s", b, T.STRING)])
+    e.dtype = T.STRING
+    comp = ExprCompiler.for_batch(b).compile(e)
+    vals, _ = comp.fn(Env.from_batch(b))
+    ids = np.asarray(vals)[:3]
+    out = [comp.out_dict.values[i] for i in ids]
+    assert out == ["Hello", "World", ""]
+
+
+def test_case_expr():
+    b = make_batch()
+    cond = E.Binary(E.BinOp.GTE, col("a", b, T.INT64), lit(3, T.INT64))
+    cond.dtype = T.BOOL
+    e = E.Case([(cond, lit(1, T.INT64))], lit(0, T.INT64))
+    e.dtype = T.INT64
+    v, n, _ = run(e, b)
+    assert list(v) == [0, 0, 1, 1]
+
+
+def test_extract_year_month():
+    b = make_batch()
+    e = E.Func("year", [col("d", b, T.DATE32)])
+    e.dtype = T.INT32
+    v, n, _ = run(e, b)
+    # days 8766=1994-01-01, 9131=1995-01-01, 10000=1997-05-19, 10592=1999-01-01
+    assert list(v) == [1994, 1995, 1997, 1999]
+    e2 = E.Func("month", [col("d", b, T.DATE32)])
+    e2.dtype = T.INT32
+    v2, _, _ = run(e2, b)
+    assert list(v2) == [1, 1, 5, 1]
+
+
+def test_in_list_string():
+    b = make_batch()
+    e = E.InList(col("s", b, T.STRING), [lit("foo", T.STRING), lit("FOO", T.STRING)])
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    assert list(v[:3]) == [True, False, True]
+
+
+def test_is_null():
+    b = make_batch()
+    e = E.IsNull(col("b", b, T.FLOAT64))
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    assert list(v) == [False, True, False, False]
+    assert not any(n)
+
+
+def test_substr_and_length():
+    t = pa.table({"s": pa.array(["hello", "hi"])})
+    b = B.from_arrow(t)
+    e = E.Func("substr", [col("s", b, T.STRING), lit(1, T.INT64), lit(2, T.INT64)])
+    e.dtype = T.STRING
+    comp = ExprCompiler.for_batch(b).compile(e)
+    vals, _ = comp.fn(Env.from_batch(b))
+    ids = np.asarray(vals)[:2]
+    assert [comp.out_dict.values[i] for i in ids] == ["he", "hi"]
+    e2 = E.Func("length", [col("s", b, T.STRING)])
+    e2.dtype = T.INT32
+    v, _, _ = run(e2, b)
+    assert list(v) == [5, 2]
+
+
+def test_in_list_no_fractional_truncation():
+    b = make_batch()
+    e = E.InList(col("a", b, T.INT64), [lit(1.5, T.FLOAT64), lit(3.0, T.FLOAT64)])
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    assert list(v) == [False, False, True, False]  # 1 must NOT match 1.5
+
+
+def test_in_list_null_item_semantics():
+    b = make_batch()
+    nl = E.Literal(None, None)
+    e = E.InList(col("a", b, T.INT64), [lit(2, T.INT64), nl])
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    assert (v[1], n[1]) == (True, False)      # match -> TRUE
+    assert n[0] and n[2] and n[3]             # non-match with NULL item -> NULL
+
+
+def test_date_vs_timestamp_comparison_scales():
+    b = make_batch()
+    # d row0 = day 8766 (1994-01-01); timestamp literal 1994-06-01 in us
+    ts_us = 8917 * 86_400_000_000
+    e = E.Binary(E.BinOp.LT, col("d", b, T.DATE32), lit(ts_us, T.TIMESTAMP))
+    e.dtype = T.BOOL
+    v, n, _ = run(e, b)
+    assert list(v) == [True, False, False, False]
+
+
+def test_coalesce_cross_dictionary_strings():
+    t = pa.table({
+        "x": pa.array(["aa", None]),
+        "y": pa.array(["zz", "zz"]),
+    })
+    b = B.from_arrow(t)
+    e = E.Func("coalesce", [col("x", b, T.STRING), col("y", b, T.STRING)])
+    e.dtype = T.STRING
+    comp = ExprCompiler.for_batch(b).compile(e)
+    vals, nulls = comp.fn(Env.from_batch(b))
+    ids = np.asarray(vals)[:2]
+    assert [comp.out_dict.values[i] for i in ids] == ["aa", "zz"]
+
+
+def test_cast_date_to_timestamp():
+    b = make_batch()
+    e = E.Cast(col("d", b, T.DATE32))
+    e.to = T.TIMESTAMP
+    e.dtype = T.TIMESTAMP
+    v, n, _ = run(e, b)
+    assert v[0] == 8766 * 86_400_000_000
