@@ -601,6 +601,11 @@ class ExprCompiler:
             return str_transform(lambda s: (s[:1].upper() + s[1:].lower()) if s else s)
         if name == "trim":
             return str_transform(lambda s: s.strip())
+        if name in ("left", "right"):
+            n_chars = int(e.args[1].value)
+            if name == "left":
+                return str_transform(lambda s: s[:n_chars])
+            return str_transform(lambda s: s[-n_chars:] if n_chars else "")
         if name in ("substr", "substring"):
             start = int(e.args[1].value)
             length = int(e.args[2].value) if len(e.args) > 2 else None
@@ -651,7 +656,7 @@ class ExprCompiler:
 
 
 _STRING_FUNCS = {"upper", "lower", "capitalize", "trim", "substr", "substring",
-                 "length", "char_length", "character_length", "concat"}
+                 "length", "char_length", "character_length", "concat", "left", "right"}
 
 
 def _cap(env: Env) -> int:

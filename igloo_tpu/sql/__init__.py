@@ -1,0 +1,4 @@
+"""SQL frontend: lexer, parser, AST (replaces reference crates/engine/src/parser.rs
+and the DataFusion SQL planner front half)."""
+from igloo_tpu.sql.parser import SqlParseError, parse_sql, parse_statements  # noqa: F401
+from igloo_tpu.sql import ast  # noqa: F401

@@ -1,0 +1,138 @@
+"""SQL abstract syntax tree.
+
+The reference delegates SQL to sqlparser-rs (crates/engine/src/parser.rs:7-12 returns
+the last `Statement`) and to DataFusion's frontend for the working path
+(crates/engine/src/lib.rs:54-57). We own the frontend: the parser produces this AST,
+the binder (plan/binder.py) turns it into a typed logical plan.
+
+Expression nodes live in plan/expr.py and are shared between AST and logical plan —
+the parser emits unbound Expr trees directly.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from igloo_tpu.plan import expr as E
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL = "full"
+    CROSS = "cross"
+    SEMI = "semi"    # produced by IN/EXISTS rewrites, not by the grammar
+    ANTI = "anti"
+
+
+class SetOp(enum.Enum):
+    UNION = "union"
+    UNION_ALL = "union_all"
+    INTERSECT = "intersect"
+    EXCEPT = "except"
+
+
+@dataclass
+class TableRef:
+    """Base of FROM items."""
+    alias: Optional[str] = field(default=None, init=False)
+
+
+@dataclass
+class NamedTable(TableRef):
+    name: str = ""
+
+    def __repr__(self):
+        return f"table({self.name}{' as ' + self.alias if self.alias else ''})"
+
+
+@dataclass
+class DerivedTable(TableRef):
+    """(SELECT ...) AS alias in FROM."""
+    query: "SelectStmt" = None  # type: ignore[assignment]
+
+    def __repr__(self):
+        return f"derived({self.alias})"
+
+
+@dataclass
+class Join(TableRef):
+    left: TableRef = None   # type: ignore[assignment]
+    right: TableRef = None  # type: ignore[assignment]
+    join_type: JoinType = JoinType.INNER
+    on: Optional[E.Expr] = None          # ON condition
+    using: Optional[list[str]] = None    # USING (cols)
+
+    def __repr__(self):
+        return f"join({self.join_type.value}, {self.left!r}, {self.right!r})"
+
+
+@dataclass
+class ValuesTable(TableRef):
+    """VALUES (...), (...) as an inline table."""
+    rows: list[list[E.Expr]] = field(default_factory=list)
+
+
+@dataclass
+class OrderItem:
+    expr: E.Expr
+    asc: bool = True
+    nulls_first: Optional[bool] = None  # None = SQL default (nulls last if asc)
+
+
+@dataclass
+class SelectStmt:
+    """One SELECT query block (possibly with CTEs and set operations).
+
+    When `set_op` is set, this node is a set operation over `left`/`right` and the
+    select fields are unused.
+    """
+    # set operation form
+    set_op: Optional[SetOp] = None
+    left: Optional["SelectStmt"] = None
+    right: Optional["SelectStmt"] = None
+    # plain select form
+    projections: list[E.Expr] = field(default_factory=list)
+    distinct: bool = False
+    from_: Optional[TableRef] = None
+    where: Optional[E.Expr] = None
+    group_by: list[E.Expr] = field(default_factory=list)
+    having: Optional[E.Expr] = None
+    # applies to either form
+    ctes: list[tuple[str, "SelectStmt"]] = field(default_factory=list)
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+@dataclass
+class ExplainStmt:
+    query: SelectStmt = None  # type: ignore[assignment]
+    analyze: bool = False
+
+
+@dataclass
+class ShowTablesStmt:
+    pass
+
+
+@dataclass
+class DescribeStmt:
+    table: str = ""
+
+
+@dataclass
+class CreateTableAsStmt:
+    name: str = ""
+    query: SelectStmt = None  # type: ignore[assignment]
+
+
+@dataclass
+class DropTableStmt:
+    name: str = ""
+    if_exists: bool = False
+
+
+Statement = object  # SelectStmt | ExplainStmt | ShowTablesStmt | DescribeStmt | ...

@@ -1,0 +1,140 @@
+"""SQL lexer.
+
+Hand-rolled tokenizer for the engine's SQL dialect (GenericDialect-equivalent of the
+reference's sqlparser setup, crates/engine/src/parser.rs:7-9). Produces a flat token
+list consumed by the recursive-descent parser.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Tok(enum.Enum):
+    IDENT = "ident"
+    QIDENT = "qident"       # "quoted identifier"
+    NUMBER = "number"
+    STRING = "string"       # 'literal'
+    OP = "op"               # punctuation / operators
+    EOF = "eof"
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: Tok
+    text: str
+    pos: int  # character offset, for error messages
+
+    def upper(self) -> str:
+        return self.text.upper()
+
+
+class SqlLexError(Exception):
+    def __init__(self, msg: str, sql: str, pos: int):
+        line = sql.count("\n", 0, pos) + 1
+        col = pos - (sql.rfind("\n", 0, pos) + 1) + 1
+        super().__init__(f"{msg} at line {line}, column {col}")
+
+
+_TWO_CHAR_OPS = {"<>", "!=", "<=", ">=", "||", "::"}
+_ONE_CHAR_OPS = set("+-*/%(),.;=<>[]")
+
+
+def tokenize(sql: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        # comments
+        if c == "-" and sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if c == "/" and sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise SqlLexError("unterminated block comment", sql, i)
+            i = j + 2
+            continue
+        # string literal (single quotes, '' escape)
+        if c == "'":
+            j = i + 1
+            buf = []
+            while True:
+                if j >= n:
+                    raise SqlLexError("unterminated string literal", sql, i)
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            toks.append(Token(Tok.STRING, "".join(buf), i))
+            i = j + 1
+            continue
+        # quoted identifier
+        if c == '"':
+            j = sql.find('"', i + 1)
+            if j < 0:
+                raise SqlLexError("unterminated quoted identifier", sql, i)
+            toks.append(Token(Tok.QIDENT, sql[i + 1: j], i))
+            i = j + 1
+            continue
+        if c == "`":
+            j = sql.find("`", i + 1)
+            if j < 0:
+                raise SqlLexError("unterminated quoted identifier", sql, i)
+            toks.append(Token(Tok.QIDENT, sql[i + 1: j], i))
+            i = j + 1
+            continue
+        # number: digits, optional fraction/exponent; also ".5"
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = seen_exp = False
+            while j < n:
+                ch = sql[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_exp:
+                    # disambiguate "1." followed by identifier (qualified name) —
+                    # only treat as fraction if next char is a digit
+                    if j + 1 < n and sql[j + 1].isdigit():
+                        seen_dot = True
+                        j += 1
+                    else:
+                        break
+                elif ch in "eE" and not seen_exp and j + 1 < n and (
+                    sql[j + 1].isdigit() or (sql[j + 1] in "+-" and j + 2 < n and sql[j + 2].isdigit())
+                ):
+                    seen_exp = True
+                    j += 2 if sql[j + 1] in "+-" else 1
+                else:
+                    break
+            toks.append(Token(Tok.NUMBER, sql[i:j], i))
+            i = j
+            continue
+        # identifier / keyword
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_" or sql[j] == "$"):
+                j += 1
+            toks.append(Token(Tok.IDENT, sql[i:j], i))
+            i = j
+            continue
+        # operators
+        if sql[i:i + 2] in _TWO_CHAR_OPS:
+            toks.append(Token(Tok.OP, sql[i:i + 2], i))
+            i += 2
+            continue
+        if c in _ONE_CHAR_OPS:
+            toks.append(Token(Tok.OP, c, i))
+            i += 1
+            continue
+        raise SqlLexError(f"unexpected character {c!r}", sql, i)
+    toks.append(Token(Tok.EOF, "", n))
+    return toks

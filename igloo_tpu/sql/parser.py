@@ -1,0 +1,785 @@
+"""Recursive-descent SQL parser.
+
+Own frontend replacing the reference's sqlparser-rs shim (crates/engine/src/parser.rs:7-12)
+and DataFusion's SQL planner on the working path (crates/engine/src/lib.rs:54-57).
+Parses the dialect needed for TPC-H and the reference's demo queries: SELECT blocks
+with CTEs, joins, subqueries (scalar / IN / EXISTS), set operations, aggregates,
+CASE/CAST/EXTRACT/INTERVAL/BETWEEN/LIKE, plus a few utility statements
+(EXPLAIN, SHOW TABLES, DESCRIBE, CREATE TABLE AS, DROP TABLE).
+
+Mirrors the reference's single-statement semantics: `parse_sql` returns the LAST
+statement when several are separated by ';' (crates/engine/src/parser.rs:10-11).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Optional
+
+from igloo_tpu import types as T
+from igloo_tpu.plan import expr as E
+from igloo_tpu.sql import ast as A
+from igloo_tpu.sql.lexer import Tok, Token, tokenize
+
+_EPOCH = _dt.date(1970, 1, 1).toordinal()
+
+
+class SqlParseError(Exception):
+    pass
+
+
+_RESERVED_STOP = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION",
+    "INTERSECT", "EXCEPT", "ON", "USING", "JOIN", "INNER", "LEFT", "RIGHT",
+    "FULL", "CROSS", "OUTER", "AS", "AND", "OR", "NOT", "WHEN", "THEN", "ELSE",
+    "END", "BY", "ASC", "DESC", "NULLS", "FIRST", "LAST", "SELECT", "DISTINCT",
+    "ALL", "WITH", "CASE", "BETWEEN", "IN", "IS", "LIKE", "ILIKE", "EXISTS",
+    "NULL", "TRUE", "FALSE", "CAST", "INTERVAL", "EXTRACT", "VALUES", "SEMI",
+    "ANTI", "NATURAL",
+}
+
+_TYPE_NAMES = {
+    "INT": T.INT32, "INTEGER": T.INT32, "SMALLINT": T.INT32, "TINYINT": T.INT32,
+    "BIGINT": T.INT64, "LONG": T.INT64,
+    "FLOAT": T.FLOAT32, "REAL": T.FLOAT32,
+    "DOUBLE": T.FLOAT64, "DECIMAL": T.FLOAT64, "NUMERIC": T.FLOAT64,
+    "VARCHAR": T.STRING, "CHAR": T.STRING, "TEXT": T.STRING, "STRING": T.STRING,
+    "DATE": T.DATE32, "TIMESTAMP": T.TIMESTAMP, "DATETIME": T.TIMESTAMP,
+    "BOOLEAN": T.BOOL, "BOOL": T.BOOL,
+}
+
+
+def parse_sql(sql: str) -> object:
+    """Parse `sql`; if multiple ';'-separated statements, return the last (parity with
+    reference parser.rs:10-11)."""
+    stmts = parse_statements(sql)
+    if not stmts:
+        raise SqlParseError("empty SQL input")
+    return stmts[-1]
+
+
+def parse_statements(sql: str) -> list[object]:
+    p = Parser(tokenize(sql), sql)
+    out = []
+    while not p.at(Tok.EOF):
+        if p.try_op(";"):
+            continue
+        out.append(p.parse_statement())
+    return out
+
+
+class Parser:
+    def __init__(self, toks: list[Token], sql: str):
+        self.toks = toks
+        self.sql = sql
+        self.i = 0
+
+    # --- token helpers ---
+
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def at(self, kind: Tok) -> bool:
+        return self.peek().kind == kind
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == Tok.IDENT and t.upper() in kws
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != Tok.EOF:
+            self.i += 1
+        return t
+
+    def try_kw(self, *kws: str) -> Optional[str]:
+        if self.at_kw(*kws):
+            return self.next().upper()
+        return None
+
+    def expect_kw(self, kw: str):
+        if not self.try_kw(kw):
+            self.err(f"expected {kw}")
+
+    def try_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == Tok.OP and t.text == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.try_op(op):
+            self.err(f"expected '{op}'")
+
+    def err(self, msg: str):
+        t = self.peek()
+        line = self.sql.count("\n", 0, t.pos) + 1
+        col = t.pos - (self.sql.rfind("\n", 0, t.pos) + 1) + 1
+        got = t.text if t.kind != Tok.EOF else "<end of input>"
+        raise SqlParseError(f"{msg}, got {got!r} at line {line}, column {col}")
+
+    def ident(self, what: str = "identifier") -> str:
+        t = self.peek()
+        if t.kind == Tok.QIDENT:
+            self.next()
+            return t.text
+        if t.kind == Tok.IDENT:
+            if t.upper() in _RESERVED_STOP:
+                self.err(f"expected {what}")
+            self.next()
+            return t.text.lower()
+        self.err(f"expected {what}")
+
+    # --- statements ---
+
+    def parse_statement(self) -> object:
+        if self.at_kw("EXPLAIN"):
+            self.next()
+            analyze = self.try_kw("ANALYZE") is not None
+            return A.ExplainStmt(query=self.parse_query(), analyze=analyze)
+        if self.at_kw("SHOW"):
+            self.next()
+            self.expect_kw("TABLES")
+            return A.ShowTablesStmt()
+        if self.at_kw("DESCRIBE", "DESC"):
+            self.next()
+            return A.DescribeStmt(table=self.ident("table name"))
+        if self.at_kw("CREATE"):
+            self.next()
+            self.expect_kw("TABLE")
+            name = self.ident("table name")
+            self.expect_kw("AS")
+            return A.CreateTableAsStmt(name=name, query=self.parse_query())
+        if self.at_kw("DROP"):
+            self.next()
+            self.expect_kw("TABLE")
+            if_exists = False
+            if self.try_kw("IF"):
+                self.expect_kw("EXISTS")
+                if_exists = True
+            return A.DropTableStmt(name=self.ident("table name"), if_exists=if_exists)
+        return self.parse_query()
+
+    # --- queries ---
+
+    def parse_query(self) -> A.SelectStmt:
+        ctes: list[tuple[str, A.SelectStmt]] = []
+        if self.try_kw("WITH"):
+            while True:
+                name = self.ident("CTE name")
+                self.expect_kw("AS")
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                ctes.append((name, q))
+                if not self.try_op(","):
+                    break
+        stmt = self.parse_set_expr()
+        # trailing ORDER BY / LIMIT apply to the whole set expression; if the inner
+        # statement already carries its own (e.g. "(SELECT ... LIMIT 5) LIMIT 3"),
+        # wrap it as a derived table so both layers apply in order
+        order_by, limit, offset = self.parse_order_limit()
+        if (order_by or limit is not None or offset is not None) and (
+            stmt.order_by or stmt.limit is not None or stmt.offset is not None
+        ):
+            inner = stmt
+            dt = A.DerivedTable(query=inner)
+            dt.alias = "_q"
+            stmt = A.SelectStmt(projections=[E.Star()], from_=dt)
+        if order_by:
+            stmt.order_by = order_by
+        if limit is not None:
+            stmt.limit = limit
+        if offset is not None:
+            stmt.offset = offset
+        stmt.ctes = ctes + stmt.ctes
+        return stmt
+
+    def _int_tok(self, what: str) -> int:
+        t = self.next()
+        if t.kind != Tok.NUMBER or not t.text.lstrip("+-").isdigit():
+            self.i -= 1
+            self.err(f"expected integer {what}")
+        return int(t.text)
+
+    def parse_order_limit(self):
+        order_by: list[A.OrderItem] = []
+        limit = offset = None
+        if self.try_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                ex = self.parse_expr()
+                asc = True
+                if self.try_kw("ASC"):
+                    asc = True
+                elif self.try_kw("DESC"):
+                    asc = False
+                nulls_first = None
+                if self.try_kw("NULLS"):
+                    if self.try_kw("FIRST"):
+                        nulls_first = True
+                    else:
+                        self.expect_kw("LAST")
+                        nulls_first = False
+                order_by.append(A.OrderItem(ex, asc, nulls_first))
+                if not self.try_op(","):
+                    break
+        if self.try_kw("LIMIT"):
+            limit = self._int_tok("LIMIT count")
+        if self.try_kw("OFFSET"):
+            offset = self._int_tok("OFFSET count")
+            self.try_kw("ROWS", "ROW")
+        return order_by, limit, offset
+
+    def parse_set_expr(self) -> A.SelectStmt:
+        # standard SQL: INTERSECT binds tighter than UNION/EXCEPT
+        left = self.parse_intersect_expr()
+        while True:
+            if self.try_kw("UNION"):
+                all_ = self.try_kw("ALL") is not None
+                self.try_kw("DISTINCT")
+                right = self.parse_intersect_expr()
+                op = A.SetOp.UNION_ALL if all_ else A.SetOp.UNION
+                left = A.SelectStmt(set_op=op, left=left, right=right)
+            elif self.try_kw("EXCEPT"):
+                self.try_kw("DISTINCT")
+                right = self.parse_intersect_expr()
+                left = A.SelectStmt(set_op=A.SetOp.EXCEPT, left=left, right=right)
+            else:
+                return left
+
+    def parse_intersect_expr(self) -> A.SelectStmt:
+        left = self.parse_select_core()
+        while self.try_kw("INTERSECT"):
+            self.try_kw("DISTINCT")
+            right = self.parse_select_core()
+            left = A.SelectStmt(set_op=A.SetOp.INTERSECT, left=left, right=right)
+        return left
+
+    def parse_select_core(self) -> A.SelectStmt:
+        if self.try_op("("):
+            q = self.parse_query()
+            self.expect_op(")")
+            return q
+        if self.at_kw("VALUES"):
+            self.next()
+            rows = self.parse_values_rows()
+            vt = A.ValuesTable(rows=rows)
+            vt.alias = "values"
+            cols = [E.Column(f"column{i + 1}") for i in range(len(rows[0]) if rows else 0)]
+            return A.SelectStmt(projections=cols, from_=vt)
+        self.expect_kw("SELECT")
+        distinct = False
+        if self.try_kw("DISTINCT"):
+            distinct = True
+        else:
+            self.try_kw("ALL")
+        projections = [self.parse_select_item()]
+        while self.try_op(","):
+            projections.append(self.parse_select_item())
+        from_ = None
+        if self.try_kw("FROM"):
+            from_ = self.parse_from()
+        where = None
+        if self.try_kw("WHERE"):
+            where = self.parse_expr()
+        group_by: list[E.Expr] = []
+        if self.try_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.parse_expr())
+            while self.try_op(","):
+                group_by.append(self.parse_expr())
+        having = None
+        if self.try_kw("HAVING"):
+            having = self.parse_expr()
+        return A.SelectStmt(projections=projections, distinct=distinct, from_=from_,
+                            where=where, group_by=group_by, having=having)
+
+    def parse_values_rows(self) -> list[list[E.Expr]]:
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.parse_expr()]
+            while self.try_op(","):
+                row.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.try_op(","):
+                return rows
+
+    def parse_select_item(self) -> E.Expr:
+        if self.try_op("*"):
+            return E.Star()
+        # qualified star: ident.*
+        if self.peek().kind in (Tok.IDENT, Tok.QIDENT) and \
+           self.peek(1).kind == Tok.OP and self.peek(1).text == "." and \
+           self.peek(2).kind == Tok.OP and self.peek(2).text == "*" and \
+           (self.peek().kind == Tok.QIDENT or self.peek().upper() not in _RESERVED_STOP):
+            q = self.ident()
+            self.next()  # .
+            self.next()  # *
+            return E.Star(qualifier=q)
+        ex = self.parse_expr()
+        if self.try_kw("AS"):
+            alias = self.ident_or_kw("alias")
+            return E.Alias(operand=ex, alias=alias)
+        # bare alias (identifier not a clause keyword)
+        t = self.peek()
+        if t.kind == Tok.QIDENT or (t.kind == Tok.IDENT and t.upper() not in _RESERVED_STOP):
+            return E.Alias(operand=ex, alias=self.ident())
+        return ex
+
+    def ident_or_kw(self, what: str) -> str:
+        """After AS, even keywords may serve as aliases (e.g. AS count)."""
+        t = self.peek()
+        if t.kind == Tok.QIDENT:
+            self.next()
+            return t.text
+        if t.kind == Tok.IDENT:
+            self.next()
+            return t.text.lower()
+        self.err(f"expected {what}")
+
+    # --- FROM / joins ---
+
+    def parse_from(self) -> A.TableRef:
+        left = self.parse_join_tree()
+        while self.try_op(","):
+            right = self.parse_join_tree()
+            left = A.Join(left=left, right=right, join_type=A.JoinType.CROSS)
+        return left
+
+    def parse_join_tree(self) -> A.TableRef:
+        left = self.parse_table_factor()
+        while True:
+            natural = False
+            if self.at_kw("NATURAL"):
+                self.next()
+                natural = True
+            jt = None
+            if self.try_kw("JOIN"):
+                jt = A.JoinType.INNER
+            elif self.try_kw("INNER"):
+                self.expect_kw("JOIN")
+                jt = A.JoinType.INNER
+            elif self.try_kw("LEFT"):
+                self.try_kw("OUTER")
+                if self.try_kw("SEMI"):
+                    jt = A.JoinType.SEMI
+                elif self.try_kw("ANTI"):
+                    jt = A.JoinType.ANTI
+                else:
+                    jt = A.JoinType.LEFT
+                self.expect_kw("JOIN")
+            elif self.try_kw("RIGHT"):
+                self.try_kw("OUTER")
+                self.expect_kw("JOIN")
+                jt = A.JoinType.RIGHT
+            elif self.try_kw("FULL"):
+                self.try_kw("OUTER")
+                self.expect_kw("JOIN")
+                jt = A.JoinType.FULL
+            elif self.try_kw("CROSS"):
+                self.expect_kw("JOIN")
+                jt = A.JoinType.CROSS
+            else:
+                if natural:
+                    self.err("expected JOIN after NATURAL")
+                return left
+            right = self.parse_table_factor()
+            on = None
+            using = None
+            if jt is not A.JoinType.CROSS and not natural:
+                if self.try_kw("ON"):
+                    on = self.parse_expr()
+                elif self.try_kw("USING"):
+                    self.expect_op("(")
+                    using = [self.ident("column")]
+                    while self.try_op(","):
+                        using.append(self.ident("column"))
+                    self.expect_op(")")
+                else:
+                    self.err("expected ON or USING")
+            if natural:
+                using = []  # binder resolves shared columns
+            left = A.Join(left=left, right=right, join_type=jt, on=on, using=using)
+
+    def parse_table_factor(self) -> A.TableRef:
+        if self.try_op("("):
+            if self.at_kw("SELECT", "WITH", "VALUES"):
+                q = self.parse_query()
+                self.expect_op(")")
+                ref: A.TableRef = A.DerivedTable(query=q)
+            elif self.peek().kind == Tok.OP and self.peek().text == "(":
+                # ambiguous: "((SELECT ...))" vs "((a JOIN b ...))" — try query first,
+                # backtrack to a parenthesized join on failure
+                save = self.i
+                try:
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    ref = A.DerivedTable(query=q)
+                except SqlParseError:
+                    self.i = save
+                    ref = self.parse_from()
+                    self.expect_op(")")
+            else:
+                ref = self.parse_from()
+                self.expect_op(")")
+        elif self.at_kw("VALUES"):
+            self.next()
+            ref = A.ValuesTable(rows=self.parse_values_rows())
+        else:
+            name = self.ident("table name")
+            while self.try_op("."):
+                name += "." + self.ident("table name part")
+            ref = A.NamedTable(name=name)
+        if self.try_kw("AS"):
+            ref.alias = self.ident_or_kw("alias")
+        else:
+            t = self.peek()
+            if t.kind == Tok.QIDENT or (t.kind == Tok.IDENT and t.upper() not in _RESERVED_STOP):
+                ref.alias = self.ident()
+        return ref
+
+    # --- expressions (precedence climbing) ---
+
+    def parse_expr(self) -> E.Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> E.Expr:
+        left = self.parse_and()
+        while self.try_kw("OR"):
+            left = E.Binary(op=E.BinOp.OR, left=left, right=self.parse_and())
+        return left
+
+    def parse_and(self) -> E.Expr:
+        left = self.parse_not()
+        while self.try_kw("AND"):
+            left = E.Binary(op=E.BinOp.AND, left=left, right=self.parse_not())
+        return left
+
+    def parse_not(self) -> E.Expr:
+        if self.try_kw("NOT"):
+            return E.Not(operand=self.parse_not())
+        return self.parse_comparison()
+
+    _CMP = {"=": E.BinOp.EQ, "<>": E.BinOp.NEQ, "!=": E.BinOp.NEQ, "<": E.BinOp.LT,
+            "<=": E.BinOp.LTE, ">": E.BinOp.GT, ">=": E.BinOp.GTE}
+
+    def parse_comparison(self) -> E.Expr:
+        left = self.parse_additive()
+        while True:
+            t = self.peek()
+            if t.kind == Tok.OP and t.text in self._CMP:
+                self.next()
+                # comparison with subquery: = (SELECT ...) treated as scalar subquery
+                right = self.parse_additive()
+                left = E.Binary(op=self._CMP[t.text], left=left, right=right)
+                continue
+            negated = False
+            save = self.i
+            if self.try_kw("NOT"):
+                negated = True
+            if self.try_kw("BETWEEN"):
+                low = self.parse_additive()
+                self.expect_kw("AND")
+                high = self.parse_additive()
+                rng = E.Binary(op=E.BinOp.AND,
+                               left=E.Binary(op=E.BinOp.GTE, left=left, right=low),
+                               right=E.Binary(op=E.BinOp.LTE, left=left, right=high))
+                left = E.Not(operand=rng) if negated else rng
+                continue
+            if self.try_kw("IN"):
+                self.expect_op("(")
+                if self.at_kw("SELECT", "WITH"):
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    left = E.InSubquery(operand=left, query=q, negated=negated)
+                else:
+                    items = [self.parse_expr()]
+                    while self.try_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = E.InList(operand=left, items=items, negated=negated)
+                continue
+            if self.at_kw("LIKE", "ILIKE"):
+                ci = self.next().upper() == "ILIKE"
+                pat = self.parse_additive()
+                if not isinstance(pat, E.Literal) or not isinstance(pat.value, str):
+                    self.err("LIKE pattern must be a string literal")
+                left = E.Like(operand=left, pattern=pat.value, negated=negated,
+                              case_insensitive=ci)
+                continue
+            if negated:
+                self.i = save  # NOT belonged to something else
+                return left
+            if self.try_kw("IS"):
+                neg = self.try_kw("NOT") is not None
+                if self.try_kw("NULL"):
+                    left = E.IsNull(operand=left, negated=neg)
+                else:
+                    if self.try_kw("TRUE"):
+                        bv = True
+                    elif self.try_kw("FALSE"):
+                        bv = False
+                    else:
+                        self.err("expected NULL/TRUE/FALSE after IS")
+                    # IS [NOT] TRUE/FALSE: never NULL -> NOT(IsNull(x)) AND x = bv
+                    cmpe = E.Binary(op=E.BinOp.EQ, left=left,
+                                    right=E.Literal(value=bv, literal_type=T.BOOL))
+                    isn = E.IsNull(operand=left)
+                    t_ = E.Binary(op=E.BinOp.AND, left=E.Not(operand=isn), right=cmpe)
+                    left = E.Not(operand=t_) if neg else t_
+                continue
+            return left
+
+    def parse_additive(self) -> E.Expr:
+        left = self.parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == Tok.OP and t.text == "+":
+                self.next()
+                left = E.Binary(op=E.BinOp.ADD, left=left, right=self.parse_multiplicative())
+            elif t.kind == Tok.OP and t.text == "-":
+                self.next()
+                left = E.Binary(op=E.BinOp.SUB, left=left, right=self.parse_multiplicative())
+            elif t.kind == Tok.OP and t.text == "||":
+                self.next()
+                right = self.parse_multiplicative()
+                left = E.Func(name="concat", args=[left, right])
+            else:
+                return left
+
+    def parse_multiplicative(self) -> E.Expr:
+        left = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == Tok.OP and t.text == "*":
+                self.next()
+                left = E.Binary(op=E.BinOp.MUL, left=left, right=self.parse_unary())
+            elif t.kind == Tok.OP and t.text == "/":
+                self.next()
+                left = E.Binary(op=E.BinOp.DIV, left=left, right=self.parse_unary())
+            elif t.kind == Tok.OP and t.text == "%":
+                self.next()
+                left = E.Binary(op=E.BinOp.MOD, left=left, right=self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self) -> E.Expr:
+        if self.try_op("-"):
+            operand = self.parse_unary()
+            if isinstance(operand, E.Literal) and isinstance(operand.value, (int, float)) \
+               and not isinstance(operand.value, bool):
+                operand.value = -operand.value
+                return operand
+            return E.Negate(operand=operand)
+        if self.try_op("+"):
+            return self.parse_unary()
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> E.Expr:
+        ex = self.parse_primary()
+        while True:
+            t = self.peek()
+            if t.kind == Tok.OP and t.text == "::":
+                self.next()
+                ex = E.Cast(operand=ex, to=self.parse_type_name())
+            else:
+                return ex
+
+    def parse_type_name(self) -> T.DataType:
+        name = self.ident_or_kw("type name").upper()
+        if name == "DOUBLE" and self.try_kw("PRECISION"):
+            pass
+        if name not in _TYPE_NAMES:
+            self.err(f"unknown type {name}")
+        # optional (p[,s]) as in DECIMAL(15,2), VARCHAR(25)
+        if self.try_op("("):
+            t = self.next()
+            if t.kind != Tok.NUMBER:
+                self.err("expected type parameter")
+            if self.try_op(","):
+                t = self.next()
+                if t.kind != Tok.NUMBER:
+                    self.err("expected type parameter")
+            self.expect_op(")")
+        return _TYPE_NAMES[name]
+
+    def parse_primary(self) -> E.Expr:
+        t = self.peek()
+        if t.kind == Tok.NUMBER:
+            self.next()
+            txt = t.text
+            if "." in txt or "e" in txt or "E" in txt:
+                return E.Literal(value=float(txt), literal_type=T.FLOAT64)
+            v = int(txt)
+            lt = T.INT32 if -(2 ** 31) <= v < 2 ** 31 else T.INT64
+            return E.Literal(value=v, literal_type=lt)
+        if t.kind == Tok.STRING:
+            self.next()
+            return E.Literal(value=t.text, literal_type=T.STRING)
+        if t.kind == Tok.OP and t.text == "(":
+            self.next()
+            if self.at_kw("SELECT", "WITH"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return E.ScalarSubquery(query=q)
+            ex = self.parse_expr()
+            self.expect_op(")")
+            return ex
+        if t.kind == Tok.OP and t.text == "*":
+            self.next()
+            return E.Star()
+        if t.kind == Tok.QIDENT:
+            return self.parse_name_or_call()
+        if t.kind != Tok.IDENT:
+            self.err("expected expression")
+        kw = t.upper()
+        if kw == "NULL":
+            self.next()
+            return E.Literal(value=None, literal_type=T.NULL)
+        if kw == "TRUE":
+            self.next()
+            return E.Literal(value=True, literal_type=T.BOOL)
+        if kw == "FALSE":
+            self.next()
+            return E.Literal(value=False, literal_type=T.BOOL)
+        if kw == "CASE":
+            return self.parse_case()
+        if kw == "CAST":
+            self.next()
+            self.expect_op("(")
+            ex = self.parse_expr()
+            self.expect_kw("AS")
+            to = self.parse_type_name()
+            self.expect_op(")")
+            return E.Cast(operand=ex, to=to)
+        if kw == "EXISTS":
+            self.next()
+            self.expect_op("(")
+            q = self.parse_query()
+            self.expect_op(")")
+            return E.Exists(query=q)
+        if kw == "EXTRACT":
+            self.next()
+            self.expect_op("(")
+            part = self.ident_or_kw("date part").lower()
+            self.expect_kw("FROM")
+            ex = self.parse_expr()
+            self.expect_op(")")
+            if part not in ("year", "month", "day"):
+                self.err(f"unsupported EXTRACT part {part}")
+            return E.Func(name=f"extract_{part}", args=[ex])
+        if kw == "INTERVAL":
+            self.next()
+            tv = self.next()
+            if tv.kind not in (Tok.STRING, Tok.NUMBER):
+                self.err("expected INTERVAL value")
+            # unit either inside the string ('3 month') or as a following keyword
+            text = tv.text.strip()
+            parts = text.split()
+            try:
+                if len(parts) == 2:
+                    qty, unit = int(parts[0]), parts[1].lower()
+                elif len(parts) == 1:
+                    qty = int(text)
+                    unit = self.ident_or_kw("interval unit").lower()
+                else:
+                    raise ValueError(text)
+            except ValueError:
+                self.err(f"bad INTERVAL value {tv.text!r}")
+            unit = unit.rstrip("s")
+            if unit == "day":
+                return E.Interval(days=qty)
+            if unit == "week":
+                return E.Interval(days=qty * 7)
+            if unit == "month":
+                return E.Interval(months=qty)
+            if unit == "year":
+                return E.Interval(months=qty * 12)
+            self.err(f"unsupported INTERVAL unit {unit}")
+        if kw == "DATE" and self.peek(1).kind == Tok.STRING:
+            self.next()
+            s = self.next().text
+            try:
+                d = _dt.date.fromisoformat(s)
+            except ValueError:
+                self.err(f"bad DATE literal {s!r}")
+            return E.Literal(value=d.toordinal() - _EPOCH, literal_type=T.DATE32)
+        if kw == "TIMESTAMP" and self.peek(1).kind == Tok.STRING:
+            self.next()
+            s = self.next().text
+            try:
+                ts = _dt.datetime.fromisoformat(s)
+            except ValueError:
+                self.err(f"bad TIMESTAMP literal {s!r}")
+            if ts.tzinfo is not None:  # normalize aware timestamps to UTC
+                ts = ts.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+            us = int((ts - _dt.datetime(1970, 1, 1)).total_seconds() * 1_000_000)
+            return E.Literal(value=us, literal_type=T.TIMESTAMP)
+        if kw in ("LEFT", "RIGHT") and self.peek(1).kind == Tok.OP and self.peek(1).text == "(":
+            # left(s, n) / right(s, n) string functions (names double as join keywords)
+            self.next()
+            self.next()
+            return self.parse_call(kw.lower())
+        if kw in _RESERVED_STOP:
+            self.err("expected expression")
+        return self.parse_name_or_call()
+
+    def parse_name_or_call(self) -> E.Expr:
+        name = self.ident("identifier")
+        # function call?
+        if self.peek().kind == Tok.OP and self.peek().text == "(":
+            self.next()
+            return self.parse_call(name)
+        # qualified column a.b(.c)
+        full = name
+        while self.peek().kind == Tok.OP and self.peek().text == "." and \
+                self.peek(1).kind in (Tok.IDENT, Tok.QIDENT):
+            self.next()
+            full += "." + self.ident("column name part")
+        return E.Column(name=full)
+
+    _AGG_NAMES = {"sum": E.AggFunc.SUM, "count": E.AggFunc.COUNT, "min": E.AggFunc.MIN,
+                  "max": E.AggFunc.MAX, "avg": E.AggFunc.AVG, "mean": E.AggFunc.AVG}
+
+    def parse_call(self, name: str) -> E.Expr:
+        lname = name.lower()
+        if self.try_op(")"):
+            return E.Func(name=lname, args=[])
+        distinct = self.try_kw("DISTINCT") is not None
+        if self.try_op("*"):
+            self.expect_op(")")
+            if lname == "count":
+                return E.Aggregate(func=E.AggFunc.COUNT_STAR)
+            self.err(f"{name}(*) is only valid for count")
+        args = [self.parse_expr()]
+        while self.try_op(","):
+            args.append(self.parse_expr())
+        self.expect_op(")")
+        if lname in self._AGG_NAMES:
+            if len(args) != 1:
+                raise SqlParseError(f"{name} takes exactly one argument")
+            return E.Aggregate(func=self._AGG_NAMES[lname], arg=args[0], distinct=distinct)
+        if distinct:
+            self.err("DISTINCT only valid in aggregate functions")
+        return E.Func(name=lname, args=args)
+
+    def parse_case(self) -> E.Expr:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expr()
+        whens: list[tuple[E.Expr, E.Expr]] = []
+        while self.try_kw("WHEN"):
+            cond = self.parse_expr()
+            if operand is not None:  # simple CASE: desugar to operand = cond
+                cond = E.Binary(op=E.BinOp.EQ, left=operand, right=cond)
+            self.expect_kw("THEN")
+            whens.append((cond, self.parse_expr()))
+        else_ = None
+        if self.try_kw("ELSE"):
+            else_ = self.parse_expr()
+        self.expect_kw("END")
+        return E.Case(whens=whens, else_=else_)

@@ -1,0 +1,232 @@
+"""SQL frontend tests (parser parity target: reference crates/engine/src/parser.rs
+single-statement semantics + the dialect TPC-H needs)."""
+import pytest
+
+from igloo_tpu import types as T
+from igloo_tpu.plan import expr as E
+from igloo_tpu.sql import ast as A
+from igloo_tpu.sql.parser import SqlParseError, parse_sql, parse_statements
+
+
+def test_select_basic():
+    q = parse_sql("SELECT a, b FROM t WHERE a > 10")
+    assert isinstance(q, A.SelectStmt)
+    assert len(q.projections) == 2
+    assert isinstance(q.from_, A.NamedTable) and q.from_.name == "t"
+    assert isinstance(q.where, E.Binary) and q.where.op is E.BinOp.GT
+
+
+def test_last_statement_wins():
+    # parity with reference parser.rs:10-11 (returns last statement)
+    q = parse_sql("SELECT 1; SELECT 2")
+    assert isinstance(q.projections[0], E.Literal)
+    assert q.projections[0].value == 2
+    assert len(parse_statements("SELECT 1; SELECT 2")) == 2
+
+
+def test_empty_is_error():
+    with pytest.raises(SqlParseError):
+        parse_sql("")
+
+
+def test_joins():
+    q = parse_sql("""
+        SELECT c.name, o.total FROM customers c
+        JOIN orders o ON c.id = o.customer_id
+        LEFT JOIN nation n ON c.nk = n.nk
+    """)
+    j = q.from_
+    assert isinstance(j, A.Join) and j.join_type is A.JoinType.LEFT
+    assert isinstance(j.left, A.Join) and j.left.join_type is A.JoinType.INNER
+    assert j.left.left.alias == "c"
+
+
+def test_group_order_limit():
+    q = parse_sql("""
+        SELECT l_returnflag, sum(l_quantity) AS sum_qty, count(*) c
+        FROM lineitem GROUP BY l_returnflag HAVING count(*) > 1
+        ORDER BY sum_qty DESC NULLS LAST LIMIT 10 OFFSET 2
+    """)
+    assert len(q.group_by) == 1
+    assert q.having is not None
+    assert q.limit == 10 and q.offset == 2
+    assert q.order_by[0].asc is False and q.order_by[0].nulls_first is False
+    assert isinstance(q.projections[2], E.Alias) and q.projections[2].alias == "c"
+
+
+def test_tpch_q1_shape():
+    q = parse_sql("""
+        SELECT l_returnflag, l_linestatus,
+               sum(l_quantity) as sum_qty,
+               sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+               avg(l_extendedprice) as avg_price, count(*) as count_order
+        FROM lineitem
+        WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+    """)
+    assert len(q.projections) == 6
+    sub = q.where.right
+    assert isinstance(sub, E.Binary) and sub.op is E.BinOp.SUB
+    assert isinstance(sub.right, E.Interval) and sub.right.days == 90
+
+
+def test_date_literal_days():
+    q = parse_sql("SELECT DATE '1970-01-02'")
+    lit = q.projections[0]
+    assert lit.value == 1 and lit.literal_type is T.DATE32
+
+
+def test_case_between_in_like():
+    q = parse_sql("""
+        SELECT CASE WHEN a BETWEEN 1 AND 2 THEN 'x' ELSE 'y' END,
+               b IN (1, 2, 3), c NOT LIKE 'a%', d IS NOT NULL
+        FROM t
+    """)
+    case = q.projections[0]
+    assert isinstance(case, E.Case) and case.else_ is not None
+    assert isinstance(q.projections[1], E.InList)
+    lk = q.projections[2]
+    assert isinstance(lk, E.Like) and lk.negated
+    isn = q.projections[3]
+    assert isinstance(isn, E.IsNull) and isn.negated
+
+
+def test_simple_case_desugars():
+    q = parse_sql("SELECT CASE x WHEN 1 THEN 'a' WHEN 2 THEN 'b' END FROM t")
+    case = q.projections[0]
+    cond0 = case.whens[0][0]
+    assert isinstance(cond0, E.Binary) and cond0.op is E.BinOp.EQ
+
+
+def test_subqueries():
+    q = parse_sql("""
+        SELECT * FROM t WHERE a IN (SELECT x FROM u)
+          AND EXISTS (SELECT 1 FROM v WHERE v.id = t.id)
+          AND b > (SELECT avg(x) FROM u)
+    """)
+    w = q.where
+    terms = []
+
+    def flatten(e):
+        if isinstance(e, E.Binary) and e.op is E.BinOp.AND:
+            flatten(e.left)
+            flatten(e.right)
+        else:
+            terms.append(e)
+    flatten(w)
+    kinds = {type(t).__name__ for t in terms}
+    assert "InSubquery" in kinds and "Exists" in kinds
+
+
+def test_cte_union():
+    q = parse_sql("""
+        WITH hot AS (SELECT * FROM t WHERE x > 5)
+        SELECT a FROM hot UNION ALL SELECT a FROM cold ORDER BY a LIMIT 3
+    """)
+    assert q.set_op is A.SetOp.UNION_ALL
+    assert q.ctes[0][0] == "hot"
+    assert q.limit == 3
+
+
+def test_derived_table_and_cast():
+    q = parse_sql("""
+        SELECT CAST(y AS DOUBLE PRECISION), y::bigint
+        FROM (SELECT x + 1 AS y FROM t) sub
+    """)
+    assert isinstance(q.from_, A.DerivedTable) and q.from_.alias == "sub"
+    c0, c1 = q.projections
+    assert isinstance(c0, E.Cast) and c0.to is T.FLOAT64
+    assert isinstance(c1, E.Cast) and c1.to is T.INT64
+
+
+def test_operator_precedence():
+    q = parse_sql("SELECT 1 + 2 * 3")
+    e = q.projections[0]
+    assert e.op is E.BinOp.ADD and e.right.op is E.BinOp.MUL
+    q = parse_sql("SELECT a OR b AND NOT c FROM t")
+    e = q.projections[0]
+    assert e.op is E.BinOp.OR and e.right.op is E.BinOp.AND
+    assert isinstance(e.right.right, E.Not)
+
+
+def test_count_distinct_and_extract():
+    q = parse_sql("SELECT count(DISTINCT a), EXTRACT(year FROM d) FROM t")
+    agg = q.projections[0]
+    assert isinstance(agg, E.Aggregate) and agg.distinct
+    f = q.projections[1]
+    assert isinstance(f, E.Func) and f.name == "extract_year"
+
+
+def test_values_and_misc_statements():
+    q = parse_sql("VALUES (1, 'a'), (2, 'b')")
+    assert isinstance(q.from_, A.ValuesTable) and len(q.from_.rows) == 2
+    assert isinstance(parse_sql("SHOW TABLES"), A.ShowTablesStmt)
+    d = parse_sql("DESCRIBE lineitem")
+    assert isinstance(d, A.DescribeStmt) and d.table == "lineitem"
+    e = parse_sql("EXPLAIN SELECT 1")
+    assert isinstance(e, A.ExplainStmt)
+    c = parse_sql("CREATE TABLE t2 AS SELECT * FROM t")
+    assert isinstance(c, A.CreateTableAsStmt) and c.name == "t2"
+    dr = parse_sql("DROP TABLE IF EXISTS t2")
+    assert isinstance(dr, A.DropTableStmt) and dr.if_exists
+
+
+def test_error_messages_have_position():
+    with pytest.raises(SqlParseError) as ei:
+        parse_sql("SELECT FROM t")
+    assert "line 1" in str(ei.value)
+
+
+def test_quoted_identifiers_and_concat():
+    q = parse_sql('SELECT "Weird Col" || \'!\' FROM "My Table"')
+    f = q.projections[0]
+    assert isinstance(f, E.Func) and f.name == "concat"
+    assert q.from_.name == "My Table"
+
+
+def test_string_escape():
+    q = parse_sql("SELECT 'it''s'")
+    assert q.projections[0].value == "it's"
+
+
+def test_left_right_functions():
+    q = parse_sql("SELECT left(name, 3), right(name, 2) FROM t")
+    assert q.projections[0].name == "left"
+    assert q.projections[1].name == "right"
+
+
+def test_nested_limit_wraps_as_subquery():
+    q = parse_sql("(SELECT a FROM t ORDER BY a LIMIT 5) ORDER BY a DESC")
+    assert isinstance(q.from_, A.DerivedTable)
+    assert q.from_.query.limit == 5 and not q.from_.query.order_by[0].asc is False
+    assert q.order_by[0].asc is False and q.limit is None
+
+
+def test_intersect_precedence():
+    q = parse_sql("SELECT 1 UNION SELECT 2 INTERSECT SELECT 2")
+    assert q.set_op is A.SetOp.UNION
+    assert q.right.set_op is A.SetOp.INTERSECT
+
+
+def test_bad_limit_and_interval_raise_parse_error():
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT a FROM t LIMIT 1.5")
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT INTERVAL '1 year 2 month'")
+
+
+def test_timestamp_with_offset():
+    q = parse_sql("SELECT TIMESTAMP '2020-01-01 01:00:00+01:00'")
+    assert q.projections[0].value == 1577836800_000000  # 2020-01-01T00:00:00Z
+
+
+def test_double_paren_join():
+    q = parse_sql("SELECT * FROM ((a JOIN b ON a.x = b.x))")
+    assert isinstance(q.from_, A.Join)
+
+
+def test_is_true_false():
+    q = parse_sql("SELECT a IS TRUE, a IS NOT FALSE FROM t")
+    assert isinstance(q.projections[0], E.Binary)
+    assert isinstance(q.projections[1], E.Not)
