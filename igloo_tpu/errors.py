@@ -1,0 +1,38 @@
+"""Unified error hierarchy (reference: crates/common/src/error.rs:6-21 — a thiserror
+enum with Unknown + SqlParser variants). Ours is richer because the engine surface is
+bigger; everything raised to users derives from IglooError so `QueryEngine.execute`
+reports failures instead of panicking (closes reference gap G9, engine/src/lib.rs:55-56
+uses `.expect`)."""
+from __future__ import annotations
+
+
+class IglooError(Exception):
+    """Base for all engine errors."""
+
+
+class CatalogError(IglooError):
+    """Unknown table / registration conflicts."""
+
+
+class SqlParseError(IglooError):
+    """SQL lex/parse failures (reference: Error::SqlParser, error.rs:14-16)."""
+
+
+class PlanError(IglooError):
+    """Binder/planner failures: unknown column, ambiguous name, type mismatch."""
+
+
+class ExecError(IglooError):
+    """Runtime execution failures."""
+
+
+class ConnectorError(IglooError):
+    """Source-format failures (Parquet/CSV/Iceberg/JDBC-ish)."""
+
+
+class TransportError(IglooError):
+    """RPC / serialization failures in the distributed tier."""
+
+
+class NotSupportedError(IglooError):
+    """Feature declared by SQL but outside the engine's dialect."""

@@ -558,7 +558,7 @@ class ExprCompiler:
             return Compiled(fn, out_dtype, None)
         if name == "round":
             c = args[0]
-            digits = int(e.args[1].value) if len(e.args) > 1 else 0
+            digits = _literal_int_arg(name, e.args, 1) if len(e.args) > 1 else 0
             scale = 10.0 ** digits
 
             def fn(env):
@@ -602,13 +602,13 @@ class ExprCompiler:
         if name == "trim":
             return str_transform(lambda s: s.strip())
         if name in ("left", "right"):
-            n_chars = int(e.args[1].value)
+            n_chars = _literal_int_arg(name, e.args, 1)
             if name == "left":
                 return str_transform(lambda s: s[:n_chars])
             return str_transform(lambda s: s[-n_chars:] if n_chars else "")
         if name in ("substr", "substring"):
-            start = int(e.args[1].value)
-            length = int(e.args[2].value) if len(e.args) > 2 else None
+            start = _literal_int_arg(name, e.args, 1)
+            length = _literal_int_arg(name, e.args, 2) if len(e.args) > 2 else None
             i0 = max(start - 1, 0)
 
             def sub(s):
@@ -657,6 +657,17 @@ class ExprCompiler:
 
 _STRING_FUNCS = {"upper", "lower", "capitalize", "trim", "substr", "substring",
                  "length", "char_length", "character_length", "concat", "left", "right"}
+
+
+def _literal_int_arg(fname: str, args: list, i: int) -> int:
+    """Dictionary-level string transforms need static (literal) count arguments."""
+    if i >= len(args):
+        raise ExprCompileError(f"{fname} expects an argument at position {i + 1}")
+    a = args[i]
+    if not isinstance(a, E.Literal) or isinstance(a.value, bool) or \
+            not isinstance(a.value, (int, float)):
+        raise ExprCompileError(f"{fname} argument {i + 1} must be an integer literal")
+    return int(a.value)
 
 
 def _cap(env: Env) -> int:

@@ -1,0 +1,1122 @@
+"""Binder: AST -> typed, bound logical plan.
+
+Plays the role DataFusion's SQL planner plays for the reference's working path
+(crates/engine/src/lib.rs:54-57 delegates parse→logical-plan→optimize wholesale).
+Responsibilities:
+
+- name resolution (qualified/unqualified columns, aliases, CTEs, scopes)
+- type inference (every bound Expr gets a dtype)
+- aggregate extraction (SELECT/HAVING/ORDER BY aggregates hoisted into an
+  Aggregate node, projections rewritten against its output)
+- subquery rewrites: IN/EXISTS -> semi/anti joins (with correlated-equality
+  decorrelation); uncorrelated scalar subqueries -> eager-eval placeholders;
+  correlated scalar aggregate subqueries -> group-by + join decorrelation
+- interval folding for date arithmetic
+"""
+from __future__ import annotations
+
+import copy
+import datetime as _dt
+from dataclasses import dataclass, field
+from typing import Optional
+
+from igloo_tpu import types as T
+from igloo_tpu.catalog import Catalog
+from igloo_tpu.errors import NotSupportedError, PlanError
+from igloo_tpu.plan import expr as E
+from igloo_tpu.plan import logical as L
+from igloo_tpu.sql import ast as A
+
+_EPOCH_ORD = _dt.date(1970, 1, 1).toordinal()
+
+
+# --- scopes ----------------------------------------------------------------------
+
+@dataclass
+class ScopeEntry:
+    qualifier: Optional[str]
+    name: str
+    dtype: T.DataType
+    index: int
+
+
+@dataclass
+class Scope:
+    entries: list[ScopeEntry] = field(default_factory=list)
+    parent: Optional["Scope"] = None
+
+    @staticmethod
+    def from_schema(schema, qualifier: Optional[str] = None, parent=None) -> "Scope":
+        return Scope([ScopeEntry(qualifier, f.name, f.dtype, i)
+                      for i, f in enumerate(schema)], parent)
+
+    def concat(self, other: "Scope") -> "Scope":
+        n = len(self.entries)
+        merged = list(self.entries) + [
+            ScopeEntry(e.qualifier, e.name, e.dtype, e.index + n) for e in other.entries
+        ]
+        return Scope(merged, self.parent)
+
+    def resolve(self, name: str) -> tuple[Optional[ScopeEntry], int]:
+        """Returns (entry, outer_level). outer_level 0 = this scope."""
+        parts = name.split(".")
+        if len(parts) >= 2:
+            qual, col = parts[-2].lower(), parts[-1]
+        else:
+            qual, col = None, parts[0]
+        matches = [e for e in self.entries
+                   if e.name.lower() == col.lower()
+                   and (qual is None or (e.qualifier or "").lower() == qual)]
+        if len(matches) > 1 and qual is None:
+            # unqualified ambiguity is an error only if they come from different
+            # qualifiers (duplicate output names within one table: last wins)
+            quals = {e.qualifier for e in matches}
+            if len(quals) > 1:
+                raise PlanError(f"ambiguous column reference: {name}")
+            return matches[-1], 0
+        if matches:
+            return matches[0], 0
+        if self.parent is not None:
+            e, lvl = self.parent.resolve(name)
+            return e, lvl + 1
+        return None, 0
+
+
+@dataclass
+class OuterRef(E.Expr):
+    """Placeholder for a correlated reference to an outer-query column; replaced
+    during decorrelation (never reaches the executor)."""
+    name: str = ""
+    entry: ScopeEntry = None  # type: ignore[assignment]
+
+    def __repr__(self):
+        return f"outer({self.name})"
+
+
+# --- aggregate typing ------------------------------------------------------------
+
+def agg_result_type(func: E.AggFunc, arg_dtype: Optional[T.DataType]) -> T.DataType:
+    if func in (E.AggFunc.COUNT, E.AggFunc.COUNT_STAR):
+        return T.INT64
+    if func is E.AggFunc.AVG:
+        return T.FLOAT64
+    if func is E.AggFunc.SUM:
+        if arg_dtype is None or not arg_dtype.is_numeric:
+            raise PlanError(f"sum() requires a numeric argument, got {arg_dtype}")
+        return T.INT64 if arg_dtype.is_integer else T.FLOAT64
+    # MIN/MAX keep the argument type
+    return arg_dtype  # type: ignore[return-value]
+
+
+_FUNC_TYPES = {
+    "abs": None, "sign": None,  # None => same as arg
+    "floor": T.FLOAT64, "ceil": T.FLOAT64, "sqrt": T.FLOAT64, "exp": T.FLOAT64,
+    "ln": T.FLOAT64, "log": T.FLOAT64, "log10": T.FLOAT64, "round": T.FLOAT64,
+    "power": T.FLOAT64, "pow": T.FLOAT64,
+    "sin": T.FLOAT64, "cos": T.FLOAT64, "tan": T.FLOAT64,
+    "extract_year": T.INT32, "extract_month": T.INT32, "extract_day": T.INT32,
+    "year": T.INT32, "month": T.INT32, "day": T.INT32,
+    "length": T.INT32, "char_length": T.INT32, "character_length": T.INT32,
+    "upper": T.STRING, "lower": T.STRING, "capitalize": T.STRING, "trim": T.STRING,
+    "substr": T.STRING, "substring": T.STRING, "concat": T.STRING,
+    "left": T.STRING, "right": T.STRING,
+}
+
+
+class Binder:
+    def __init__(self, catalog: Catalog, udfs: Optional[dict] = None):
+        self.catalog = catalog
+        self.udfs = udfs or {}
+        self._cte_env: dict[str, L.LogicalPlan] = {}
+        self._anon = 0
+
+    # --- entry point ---
+
+    def bind(self, stmt: A.SelectStmt) -> L.LogicalPlan:
+        return self.bind_query(stmt, outer=None)
+
+    def bind_query(self, stmt: A.SelectStmt, outer: Optional[Scope]) -> L.LogicalPlan:
+        saved = dict(self._cte_env)
+        try:
+            for name, q in stmt.ctes:
+                self._cte_env[name.lower()] = self.bind_query(q, outer)
+            if stmt.set_op is not None:
+                plan = self._bind_set_op(stmt, outer)
+            else:
+                plan = self._bind_select(stmt, outer)
+            return plan
+        finally:
+            self._cte_env = saved
+
+    # --- set operations ---
+
+    def _bind_set_op(self, stmt: A.SelectStmt, outer) -> L.LogicalPlan:
+        left = self.bind_query(stmt.left, outer)
+        right = self.bind_query(stmt.right, outer)
+        if len(left.schema) != len(right.schema):
+            raise PlanError(
+                f"set operation inputs have different column counts: "
+                f"{len(left.schema)} vs {len(right.schema)}")
+        # unify column types; keep left's names
+        casts_l, casts_r, fields = [], [], []
+        for i, (fl, fr) in enumerate(zip(left.schema, right.schema)):
+            ct = T.common_type(fl.dtype, fr.dtype)
+            fields.append(T.Field(fl.name, ct, fl.nullable or fr.nullable))
+            casts_l.append(None if fl.dtype == ct else ct)
+            casts_r.append(None if fr.dtype == ct else ct)
+        left = self._maybe_cast_all(left, casts_l)
+        right = self._maybe_cast_all(right, casts_r)
+        out_schema = T.Schema(fields)
+        if stmt.set_op is A.SetOp.UNION_ALL:
+            node: L.LogicalPlan = L.Union(inputs=[left, right])
+            node.schema = out_schema
+        elif stmt.set_op is A.SetOp.UNION:
+            u = L.Union(inputs=[left, right])
+            u.schema = out_schema
+            node = L.Distinct(input=u)
+            node.schema = out_schema
+        else:
+            node = L.SetOpJoin(left=left, right=right,
+                               anti=(stmt.set_op is A.SetOp.EXCEPT))
+            node.schema = out_schema
+        node = self._apply_order_limit(node, stmt,
+                                       Scope.from_schema(out_schema), None)
+        return node
+
+    def _maybe_cast_all(self, plan: L.LogicalPlan, casts: list) -> L.LogicalPlan:
+        if all(c is None for c in casts):
+            return plan
+        exprs, names = [], []
+        for i, f in enumerate(plan.schema):
+            col = E.Column(f.name, index=i)
+            col.dtype = f.dtype
+            if casts[i] is not None:
+                cast = E.Cast(operand=col, to=casts[i])
+                cast.dtype = casts[i]
+                exprs.append(cast)
+            else:
+                exprs.append(col)
+            names.append(f.name)
+        pr = L.Project(input=plan, exprs=exprs, names=names)
+        pr.schema = T.Schema([T.Field(n, e.dtype, True) for n, e in zip(names, exprs)])
+        return pr
+
+    # --- SELECT core ---
+
+    def _bind_select(self, stmt: A.SelectStmt, outer) -> L.LogicalPlan:
+        # FROM
+        if stmt.from_ is not None:
+            plan, scope = self._bind_from(stmt.from_, outer)
+        else:
+            plan = L.Values(rows=[[]])
+            plan.schema = T.Schema([])
+            scope = Scope([], outer)
+        scope.parent = outer
+
+        # WHERE (may rewrite plan for IN/EXISTS subqueries)
+        if stmt.where is not None:
+            plan, preds = self._bind_where(stmt.where, plan, scope)
+            for p in preds:
+                plan = self._filter(plan, p)
+
+        # expand stars & pre-process projections
+        projections = self._expand_stars(stmt.projections, scope)
+
+        # GROUP BY ordinals / aliases
+        group_items = []
+        for g in stmt.group_by:
+            g = self._resolve_positional(g, projections)
+            g = self._resolve_select_alias(g, projections)
+            group_items.append(g)
+
+        # bind projections (as written, against input scope)
+        bound_proj: list[E.Expr] = []
+        names: list[str] = []
+        for p in projections:
+            if isinstance(p, E.Alias):
+                b = self.bind_expr(p.operand, scope, plan)
+                names.append(p.alias)
+            else:
+                b = self.bind_expr(p, scope, plan)
+                names.append(p.name_hint())
+            bound_proj.append(b)
+
+        bound_groups = [self.bind_expr(g, scope, plan) for g in group_items]
+        bound_having = None
+        if stmt.having is not None:
+            h = self._resolve_select_alias(stmt.having, projections)
+            bound_having = self.bind_expr(h, scope, plan)
+
+        # ORDER BY: try output names first (post-projection), else bind to input
+        has_aggs = any(self._contains_agg(b) for b in bound_proj) or \
+            (bound_having is not None and self._contains_agg(bound_having)) or \
+            bool(bound_groups)
+
+        if has_aggs:
+            plan, bound_proj, bound_having, scope = self._build_aggregate(
+                plan, scope, bound_groups, bound_proj, bound_having, group_items, names)
+
+        if bound_having is not None:
+            if bound_having.dtype != T.BOOL:
+                raise PlanError("HAVING predicate must be boolean")
+            plan = self._filter(plan, bound_having)
+
+        # projection node
+        proj_node = L.Project(input=plan, exprs=bound_proj, names=list(names))
+        proj_node.schema = T.Schema([
+            T.Field(n, b.dtype, True) for n, b in zip(names, bound_proj)])
+        plan = proj_node
+        out_scope = Scope.from_schema(plan.schema)
+
+        if stmt.distinct:
+            d = L.Distinct(input=plan)
+            d.schema = plan.schema
+            plan = d
+
+        plan = self._apply_order_limit(plan, stmt, out_scope,
+                                       None if stmt.distinct else proj_node)
+        return plan
+
+    # --- ORDER BY / LIMIT ---
+
+    def _apply_order_limit(self, plan, stmt: A.SelectStmt, out_scope: Scope,
+                           proj_node: Optional[L.Project]) -> L.LogicalPlan:
+        if stmt.order_by:
+            keys, asc, nf = [], [], []
+            hidden: list[E.Expr] = []
+            for item in stmt.order_by:
+                ex = self._resolve_positional(item.expr, None, out_schema=plan.schema)
+                try:
+                    b = self.bind_expr(ex, out_scope, plan)
+                except PlanError:
+                    b = None
+                if b is None:
+                    if proj_node is None:
+                        raise PlanError(
+                            f"ORDER BY expression {ex!r} not in output columns")
+                    # hidden sort column: bind against projection input, append
+                    in_scope = Scope.from_schema(proj_node.input.schema)
+                    hb = self.bind_expr(ex, in_scope, proj_node.input)
+                    hname = f"__sort_{len(hidden)}"
+                    hidden.append(hb)
+                    proj_node.exprs.append(hb)
+                    proj_node.names.append(hname)
+                    proj_node.schema = T.Schema(
+                        list(proj_node.schema.fields) + [T.Field(hname, hb.dtype, True)])
+                    plan.schema = proj_node.schema if plan is proj_node else plan.schema
+                    b = E.Column(hname, index=len(proj_node.exprs) - 1)
+                    b.dtype = hb.dtype
+                keys.append(b)
+                asc.append(item.asc)
+                nf.append(item.nulls_first if item.nulls_first is not None
+                          else not item.asc)  # SQL default: NULLS LAST when ASC
+            s = L.Sort(input=plan, keys=keys, ascending=asc, nulls_first=nf)
+            s.schema = plan.schema
+            plan = s
+            if hidden and proj_node is not None:
+                # drop hidden columns with a final narrow projection
+                keep = len(proj_node.schema) - len(hidden)
+                exprs, names2 = [], []
+                for i, f in enumerate(plan.schema.fields[:keep]):
+                    c = E.Column(f.name, index=i)
+                    c.dtype = f.dtype
+                    exprs.append(c)
+                    names2.append(f.name)
+                pr = L.Project(input=plan, exprs=exprs, names=names2)
+                pr.schema = T.Schema(list(plan.schema.fields[:keep]))
+                plan = pr
+        if stmt.limit is not None or stmt.offset is not None:
+            lim = L.Limit(input=plan, limit=stmt.limit, offset=stmt.offset or 0)
+            lim.schema = plan.schema
+            plan = lim
+        return plan
+
+    def _resolve_positional(self, ex: E.Expr, projections, out_schema=None) -> E.Expr:
+        if isinstance(ex, E.Literal) and isinstance(ex.value, int) \
+                and not isinstance(ex.value, bool):
+            k = ex.value
+            if projections is not None:
+                if not (1 <= k <= len(projections)):
+                    raise PlanError(f"position {k} is out of range")
+                p = projections[k - 1]
+                return p.operand if isinstance(p, E.Alias) else p
+            if out_schema is not None:
+                if not (1 <= k <= len(out_schema)):
+                    raise PlanError(f"ORDER BY position {k} is out of range")
+                return E.Column(out_schema.fields[k - 1].name)
+        return ex
+
+    def _resolve_select_alias(self, ex: E.Expr, projections) -> E.Expr:
+        """GROUP BY / HAVING may reference SELECT aliases."""
+        aliases = {p.alias.lower(): p.operand for p in projections
+                   if isinstance(p, E.Alias)}
+
+        def sub(n):
+            if isinstance(n, E.Column) and n.name.lower() in aliases:
+                return copy.deepcopy(aliases[n.name.lower()])
+            return n
+        return E.transform(copy.deepcopy(ex), sub)
+
+    def _expand_stars(self, projections: list[E.Expr], scope: Scope) -> list[E.Expr]:
+        out = []
+        for p in projections:
+            if isinstance(p, E.Star):
+                for e in scope.entries:
+                    if p.qualifier is None or \
+                            (e.qualifier or "").lower() == p.qualifier.lower():
+                        c = E.Column(e.name if e.qualifier is None
+                                     else f"{e.qualifier}.{e.name}")
+                        out.append(c)
+                if p.qualifier is not None and not any(
+                        (e.qualifier or "").lower() == p.qualifier.lower()
+                        for e in scope.entries):
+                    raise PlanError(f"unknown table alias in {p.qualifier}.*")
+            else:
+                out.append(p)
+        if not out:
+            raise PlanError("SELECT list is empty after * expansion")
+        return out
+
+    # --- FROM / joins ---
+
+    def _bind_from(self, ref: A.TableRef, outer) -> tuple[L.LogicalPlan, Scope]:
+        if isinstance(ref, A.NamedTable):
+            name = ref.name
+            key = name.split(".")[-1].lower()
+            if key in self._cte_env:
+                plan = self._cte_env[key]
+                alias = ref.alias or key
+                return plan, Scope.from_schema(plan.schema, alias)
+            provider = self.catalog.get(name)
+            plan = L.Scan(table=name.split(".")[-1].lower(), provider=provider)
+            plan.schema = provider.schema()
+            alias = ref.alias or name.split(".")[-1].lower()
+            return plan, Scope.from_schema(plan.schema, alias)
+        if isinstance(ref, A.DerivedTable):
+            plan = self.bind_query(ref.query, outer)
+            alias = ref.alias or self._anon_name("subquery")
+            return plan, Scope.from_schema(plan.schema, alias)
+        if isinstance(ref, A.ValuesTable):
+            return self._bind_values(ref)
+        if isinstance(ref, A.Join):
+            return self._bind_join(ref, outer)
+        raise PlanError(f"unsupported FROM item {type(ref).__name__}")
+
+    def _anon_name(self, prefix: str) -> str:
+        self._anon += 1
+        return f"__{prefix}_{self._anon}"
+
+    def _bind_values(self, ref: A.ValuesTable) -> tuple[L.LogicalPlan, Scope]:
+        if not ref.rows:
+            raise PlanError("VALUES requires at least one row")
+        width = len(ref.rows[0])
+        rows = []
+        col_types: list[T.DataType] = [T.NULL] * width
+        for r in ref.rows:
+            if len(r) != width:
+                raise PlanError("VALUES rows have unequal lengths")
+            vals = []
+            for j, cell in enumerate(r):
+                cell = self._fold_intervals(cell)
+                if not isinstance(cell, E.Literal):
+                    raise NotSupportedError("VALUES cells must be literals")
+                vals.append(cell.value)
+                if cell.value is not None:
+                    col_types[j] = T.common_type(col_types[j],
+                                                 cell.literal_type or T.FLOAT64)
+            rows.append(vals)
+        fields = [T.Field(f"column{j + 1}",
+                          col_types[j] if col_types[j] != T.NULL else T.INT32, True)
+                  for j in range(width)]
+        plan = L.Values(rows=rows)
+        plan.schema = T.Schema(fields)
+        alias = ref.alias or self._anon_name("values")
+        return plan, Scope.from_schema(plan.schema, alias)
+
+    def _bind_join(self, ref: A.Join, outer) -> tuple[L.LogicalPlan, Scope]:
+        lplan, lscope = self._bind_from(ref.left, outer)
+        rplan, rscope = self._bind_from(ref.right, outer)
+        combined = lscope.concat(rscope)
+        combined.parent = outer
+        jt = ref.join_type
+
+        using = ref.using
+        if using is not None and len(using) == 0:  # NATURAL
+            lnames = {e.name.lower() for e in lscope.entries}
+            using = [e.name for e in rscope.entries if e.name.lower() in lnames]
+            if not using:
+                jt = A.JoinType.CROSS
+                using = None
+
+        left_keys: list[E.Expr] = []
+        right_keys: list[E.Expr] = []
+        residual = None
+
+        def bind_in_left(name):
+            ent, lvl = lscope.resolve(name)
+            if ent is None or lvl:
+                raise PlanError(f"USING column {name} not found on left side")
+            c = E.Column(name, index=ent.index)
+            c.dtype = ent.dtype
+            return c
+
+        def bind_in_right(name):
+            ent, lvl = rscope.resolve(name)
+            if ent is None or lvl:
+                raise PlanError(f"USING column {name} not found on right side")
+            c = E.Column(name, index=ent.index)
+            c.dtype = ent.dtype
+            return c
+
+        if using:
+            for name in using:
+                left_keys.append(bind_in_left(name))
+                right_keys.append(bind_in_right(name))
+        elif ref.on is not None:
+            n_left = len(lscope.entries)
+            conjuncts = _split_conjuncts(self.bind_expr(ref.on, combined, None))
+            residual_parts = []
+            for c in conjuncts:
+                lk_rk = _extract_equi_key(c, n_left)
+                if lk_rk is not None:
+                    lk, rk = lk_rk
+                    left_keys.append(lk)
+                    right_keys.append(rk)
+                else:
+                    residual_parts.append(c)
+            residual = _and_all(residual_parts)
+        elif jt is not A.JoinType.CROSS:
+            raise PlanError("JOIN requires ON or USING")
+
+        node = L.Join(left=lplan, right=rplan, join_type=jt,
+                      left_keys=left_keys, right_keys=right_keys, residual=residual)
+        # output schema: left + right (semi/anti: left only)
+        if jt in (A.JoinType.SEMI, A.JoinType.ANTI):
+            node.schema = lplan.schema
+            out_scope = lscope
+        elif using:
+            # USING outputs the shared column once (from the left)
+            drop = {n.lower() for n in using}
+            rfields = [f for f in rplan.schema if f.name.lower() not in drop]
+            node.schema = T.Schema(_dedup_fields(list(lplan.schema) + rfields))
+            # scope: left entries + right minus using
+            rentries = [e for e in rscope.entries if e.name.lower() not in drop]
+            out_scope = Scope(list(lscope.entries) + [
+                ScopeEntry(e.qualifier, e.name, e.dtype,
+                           len(lplan.schema) + i) for i, e in enumerate(rentries)])
+            node = self._project_using(node, lplan, rplan, drop)
+        else:
+            node.schema = T.Schema(_dedup_fields(
+                list(lplan.schema) + list(rplan.schema)))
+            out_scope = combined
+        out_scope.parent = outer
+        return node, out_scope
+
+    def _project_using(self, join: L.Join, lplan, rplan, drop: set) -> L.LogicalPlan:
+        """Narrow a USING join's raw (left++right) output to drop the right-side
+        duplicate key columns, keeping scope indices consistent."""
+        exprs, names = [], []
+        full = list(lplan.schema) + list(rplan.schema)
+        for i, f in enumerate(full):
+            if i >= len(lplan.schema) and f.name.lower() in drop:
+                continue
+            c = E.Column(f.name, index=i)
+            c.dtype = f.dtype
+            exprs.append(c)
+            names.append(f.name)
+        raw_schema = T.Schema(_dedup_fields(full))
+        join.schema = raw_schema
+        pr = L.Project(input=join, exprs=exprs, names=names)
+        pr.schema = T.Schema([T.Field(n, e.dtype, True) for n, e in zip(names, exprs)])
+        return pr
+
+    # --- WHERE with subquery rewrites ---
+
+    def _bind_where(self, where: E.Expr, plan: L.LogicalPlan,
+                    scope: Scope) -> tuple[L.LogicalPlan, list[E.Expr]]:
+        conjuncts = _split_conjuncts_ast(where)
+        preds: list[E.Expr] = []
+        for c in conjuncts:
+            neg = False
+            inner = c
+            while isinstance(inner, E.Not):
+                neg = not neg
+                inner = inner.operand
+            if isinstance(inner, E.InSubquery):
+                plan = self._rewrite_in_subquery(
+                    inner, plan, scope, anti=(neg != inner.negated))
+            elif isinstance(inner, E.Exists):
+                plan = self._rewrite_exists(
+                    inner, plan, scope, anti=(neg != inner.negated))
+            else:
+                preds.append(self.bind_expr(c, scope, plan))
+        for p in preds:
+            if p.dtype != T.BOOL:
+                raise PlanError(f"WHERE predicate must be boolean, got {p.dtype}")
+        return plan, preds
+
+    def _rewrite_in_subquery(self, node: E.InSubquery, plan, scope, anti: bool):
+        sub = self.bind_query(node.query, scope)
+        if len(sub.schema) != 1:
+            raise PlanError("IN subquery must return exactly one column")
+        probe = self.bind_expr(node.operand, scope, plan)
+        sub, corr_l, corr_r = self._decorrelate(sub, plan.schema)
+        key_r = E.Column(sub.schema.fields[0].name, index=0)
+        key_r.dtype = sub.schema.fields[0].dtype
+        j = L.Join(left=plan, right=sub,
+                   join_type=A.JoinType.ANTI if anti else A.JoinType.SEMI,
+                   left_keys=[probe] + corr_l, right_keys=[key_r] + corr_r)
+        j.schema = plan.schema
+        return j
+
+    def _rewrite_exists(self, node: E.Exists, plan, scope, anti: bool):
+        sub = self.bind_query(node.query, scope)
+        sub, corr_l, corr_r = self._decorrelate(sub, plan.schema)
+        if not corr_l:
+            # uncorrelated EXISTS: degenerate — keep all or no rows; model as
+            # cross-semi on constant key
+            one = E.Literal(value=1, literal_type=T.INT32)
+            one.dtype = T.INT32
+            corr_l, corr_r = [one], [copy.deepcopy(one)]
+            # project subquery to the constant too
+            ce = E.Literal(value=1, literal_type=T.INT32)
+            ce.dtype = T.INT32
+            pr = L.Project(input=sub, exprs=[ce], names=["__one"])
+            pr.schema = T.Schema([T.Field("__one", T.INT32, False)])
+            sub = pr
+            corr_r = [E.Column("__one", index=0)]
+            corr_r[0].dtype = T.INT32
+        j = L.Join(left=plan, right=sub,
+                   join_type=A.JoinType.ANTI if anti else A.JoinType.SEMI,
+                   left_keys=corr_l, right_keys=corr_r)
+        j.schema = plan.schema
+        return j
+
+    def _decorrelate(self, sub: L.LogicalPlan, outer_schema):
+        """Pull correlated equality predicates (OuterRef = inner_col) out of the
+        subquery plan, returning (rewritten_sub, outer_keys, inner_key_cols).
+        Inner key columns are appended to the subquery output if not projected."""
+        corr: list[tuple[ScopeEntry, E.Expr]] = []
+
+        def strip(plan: L.LogicalPlan) -> L.LogicalPlan:
+            if isinstance(plan, L.Filter):
+                kept = []
+                for c in _split_conjuncts(plan.predicate):
+                    pair = _extract_corr_eq(c)
+                    if pair is not None:
+                        corr.append(pair)
+                    else:
+                        if any(isinstance(n, OuterRef) for n in E.walk(c)):
+                            raise NotSupportedError(
+                                f"unsupported correlated predicate: {c!r}")
+                        kept.append(c)
+                inner = strip(plan.input)
+                p = _and_all(kept)
+                if p is None:
+                    return inner
+                f = L.Filter(input=inner, predicate=p)
+                f.schema = inner.schema
+                return f
+            for i, ch in enumerate(plan.children()):
+                new = strip(ch)
+                if new is not ch:
+                    _replace_child(plan, i, new)
+            return plan
+
+        sub = strip(sub)
+        has_outer = any(isinstance(n, OuterRef) for p in L.walk_plan(sub)
+                        for ex in _plan_exprs(p) for n in E.walk(ex))
+        if has_outer:
+            raise NotSupportedError("correlated reference outside WHERE equality")
+        outer_keys, inner_cols = [], []
+        if corr:
+            # append inner key columns to the subquery output via projection
+            exprs, names = [], []
+            for i, f in enumerate(sub.schema):
+                c = E.Column(f.name, index=i)
+                c.dtype = f.dtype
+                exprs.append(c)
+                names.append(f.name)
+            base_n = len(exprs)
+            for k, (outer_entry, inner_expr) in enumerate(corr):
+                oc = E.Column(outer_entry.name, index=outer_entry.index)
+                oc.dtype = outer_entry.dtype
+                outer_keys.append(oc)
+                exprs.append(inner_expr)
+                names.append(f"__corr_{k}")
+            pr = L.Project(input=sub, exprs=exprs, names=names)
+            pr.schema = T.Schema([T.Field(n, ex.dtype, True)
+                                  for n, ex in zip(names, exprs)])
+            sub = pr
+            for k, (_, inner_expr) in enumerate(corr):
+                ic = E.Column(f"__corr_{k}", index=base_n + k)
+                ic.dtype = inner_expr.dtype
+                inner_cols.append(ic)
+        return sub, outer_keys, inner_cols
+
+    # --- aggregates ---
+
+    def _contains_agg(self, e: E.Expr) -> bool:
+        return any(isinstance(n, E.Aggregate) for n in E.walk(e))
+
+    def _build_aggregate(self, plan, scope, bound_groups, bound_proj, bound_having,
+                         group_items, names):
+        # collect distinct aggregate expressions
+        aggs: list[E.Aggregate] = []
+
+        def collect(e):
+            for n in E.walk(e):
+                if isinstance(n, E.Aggregate) and not any(_expr_eq(n, a) for a in aggs):
+                    aggs.append(n)
+        for b in bound_proj:
+            collect(b)
+        if bound_having is not None:
+            collect(bound_having)
+        for a in aggs:
+            if a.arg is not None and self._contains_agg(a.arg):
+                raise PlanError("nested aggregate functions are not allowed")
+            a.dtype = agg_result_type(a.func, a.arg.dtype if a.arg else None)
+
+        group_names = []
+        for i, (g, gi) in enumerate(zip(bound_groups, group_items)):
+            if isinstance(gi, E.Column):
+                group_names.append(gi.name_hint())
+            else:
+                group_names.append(f"__group_{i}")
+        agg_names = [f"__agg_{i}" for i in range(len(aggs))]
+
+        node = L.Aggregate(input=plan, group_exprs=bound_groups,
+                           group_names=group_names, aggs=aggs, agg_names=agg_names)
+        gfields = [T.Field(n, g.dtype, True) for n, g in zip(group_names, bound_groups)]
+        afields = [T.Field(n, a.dtype, True) for n, a in zip(agg_names, aggs)]
+        node.schema = T.Schema(gfields + afields)
+
+        # rewrite projections / having in terms of aggregate output
+        def rewrite(e: E.Expr) -> E.Expr:
+            for i, g in enumerate(bound_groups):
+                if _expr_eq(e, g):
+                    c = E.Column(group_names[i], index=i)
+                    c.dtype = g.dtype
+                    return c
+            if isinstance(e, E.Aggregate):
+                for j, a in enumerate(aggs):
+                    if _expr_eq(e, a):
+                        c = E.Column(agg_names[j], index=len(bound_groups) + j)
+                        c.dtype = a.dtype
+                        return c
+                raise PlanError("aggregate not collected (planner bug)")
+            n = copy.copy(e)
+            if isinstance(n, E.Binary):
+                n.left = rewrite(n.left)
+                n.right = rewrite(n.right)
+            elif isinstance(n, (E.Not, E.Negate, E.IsNull, E.Cast)):
+                n.operand = rewrite(n.operand)
+            elif isinstance(n, E.Case):
+                n.whens = [(rewrite(c_), rewrite(v)) for c_, v in n.whens]
+                n.else_ = rewrite(n.else_) if n.else_ is not None else None
+            elif isinstance(n, E.InList):
+                n.operand = rewrite(n.operand)
+                n.items = [rewrite(i) for i in n.items]
+            elif isinstance(n, E.Like):
+                n.operand = rewrite(n.operand)
+            elif isinstance(n, E.Func):
+                n.args = [rewrite(a) for a in n.args]
+            elif isinstance(n, E.Column):
+                raise PlanError(
+                    f"column {n.name!r} must appear in GROUP BY or an aggregate")
+            return n
+
+        new_proj = [rewrite(b) for b in bound_proj]
+        new_having = rewrite(bound_having) if bound_having is not None else None
+        return node, new_proj, new_having, Scope.from_schema(node.schema)
+
+    def _filter(self, plan: L.LogicalPlan, pred: E.Expr) -> L.LogicalPlan:
+        f = L.Filter(input=plan, predicate=pred)
+        f.schema = plan.schema
+        return f
+
+    # --- expression binding ---
+
+    def bind_expr(self, e: E.Expr, scope: Scope, plan) -> E.Expr:
+        e = self._fold_intervals(copy.deepcopy(e))
+        return self._bind_e(e, scope)
+
+    def _bind_e(self, e: E.Expr, scope: Scope) -> E.Expr:
+        if isinstance(e, OuterRef):
+            return e
+        if isinstance(e, E.Column):
+            ent, lvl = scope.resolve(e.name)
+            if ent is None:
+                raise PlanError(f"column not found: {e.name}")
+            if lvl > 0:
+                o = OuterRef(name=e.name, entry=ent)
+                o.dtype = ent.dtype
+                return o
+            c = E.Column(e.name, index=ent.index)
+            c.dtype = ent.dtype
+            return c
+        if isinstance(e, E.Literal):
+            e.dtype = e.literal_type or _literal_type_of(e.value)
+            return e
+        if isinstance(e, E.Alias):
+            b = self._bind_e(e.operand, scope)
+            a = E.Alias(operand=b, alias=e.alias)
+            a.dtype = b.dtype
+            return a
+        if isinstance(e, E.Binary):
+            left = self._bind_e(e.left, scope)
+            right = self._bind_e(e.right, scope)
+            n = E.Binary(op=e.op, left=left, right=right)
+            if e.op in (E.BinOp.AND, E.BinOp.OR):
+                for side in (left, right):
+                    if side.dtype != T.BOOL:
+                        raise PlanError(f"{e.op.value} requires boolean operands")
+                n.dtype = T.BOOL
+            elif e.op in E.COMPARISONS:
+                _check_comparable(left, right, e.op)
+                n.dtype = T.BOOL
+            else:
+                n.dtype = _arith_type(left, right, e.op)
+            return n
+        if isinstance(e, E.Not):
+            b = self._bind_e(e.operand, scope)
+            if b.dtype != T.BOOL:
+                raise PlanError("NOT requires a boolean operand")
+            n = E.Not(operand=b)
+            n.dtype = T.BOOL
+            return n
+        if isinstance(e, E.Negate):
+            b = self._bind_e(e.operand, scope)
+            if not b.dtype.is_numeric:
+                raise PlanError("unary minus requires a numeric operand")
+            n = E.Negate(operand=b)
+            n.dtype = b.dtype
+            return n
+        if isinstance(e, E.IsNull):
+            b = self._bind_e(e.operand, scope)
+            n = E.IsNull(operand=b, negated=e.negated)
+            n.dtype = T.BOOL
+            return n
+        if isinstance(e, E.Cast):
+            b = self._bind_e(e.operand, scope)
+            n = E.Cast(operand=b, to=e.to)
+            n.dtype = e.to
+            return n
+        if isinstance(e, E.Case):
+            whens = [(self._bind_e(c, scope), self._bind_e(v, scope))
+                     for c, v in e.whens]
+            else_ = self._bind_e(e.else_, scope) if e.else_ is not None else None
+            out = T.NULL
+            for c, v in whens:
+                if c.dtype != T.BOOL:
+                    raise PlanError("CASE WHEN condition must be boolean")
+                out = T.common_type(out, v.dtype)
+            if else_ is not None:
+                out = T.common_type(out, else_.dtype)
+            if out == T.NULL:
+                out = T.INT32
+            n = E.Case(whens=whens, else_=else_)
+            n.dtype = out
+            return n
+        if isinstance(e, E.InList):
+            b = self._bind_e(e.operand, scope)
+            items = [self._bind_e(i, scope) for i in e.items]
+            n = E.InList(operand=b, items=items, negated=e.negated)
+            n.dtype = T.BOOL
+            return n
+        if isinstance(e, E.Like):
+            b = self._bind_e(e.operand, scope)
+            if not b.dtype.is_string:
+                raise PlanError("LIKE requires a string operand")
+            n = E.Like(operand=b, pattern=e.pattern, negated=e.negated,
+                       case_insensitive=e.case_insensitive)
+            n.dtype = T.BOOL
+            return n
+        if isinstance(e, E.Func):
+            name = e.name.lower()
+            args = [self._bind_e(a, scope) for a in e.args]
+            n = E.Func(name=name, args=args)
+            if name in self.udfs:
+                n.dtype = self.udfs[name].return_type(
+                    [a.dtype for a in args])
+            elif name in _FUNC_TYPES:
+                rt = _FUNC_TYPES[name]
+                n.dtype = rt if rt is not None else args[0].dtype
+            elif name in ("coalesce", "nullif"):
+                out = T.NULL
+                for a in args:
+                    out = T.common_type(out, a.dtype)
+                n.dtype = out if out != T.NULL else T.INT32
+            else:
+                raise PlanError(f"unknown function: {name}")
+            return n
+        if isinstance(e, E.Aggregate):
+            arg = self._bind_e(e.arg, scope) if e.arg is not None else None
+            n = E.Aggregate(func=e.func, arg=arg, distinct=e.distinct)
+            n.dtype = agg_result_type(e.func, arg.dtype if arg else None)
+            return n
+        if isinstance(e, E.ScalarSubquery):
+            sub = self.bind_query(e.query, scope)
+            return self._bind_scalar_subquery(e, sub, scope)
+        if isinstance(e, E.Interval):
+            raise PlanError("INTERVAL is only valid in +/- date arithmetic")
+        if isinstance(e, (E.InSubquery, E.Exists)):
+            raise NotSupportedError(
+                f"{type(e).__name__} is only supported as a top-level WHERE conjunct")
+        raise PlanError(f"cannot bind expression {e!r}")
+
+    def _bind_scalar_subquery(self, e: E.ScalarSubquery, sub: L.LogicalPlan,
+                              scope: Scope) -> E.Expr:
+        if len(sub.schema) != 1:
+            raise PlanError("scalar subquery must return exactly one column")
+        has_outer = any(isinstance(n, OuterRef) for p in L.walk_plan(sub)
+                        for ex in _plan_exprs(p) for n in E.walk(ex))
+        if has_outer:
+            raise NotSupportedError(
+                "correlated scalar subqueries are rewritten by the planner; "
+                "this pattern is not yet supported")
+        n = E.ScalarSubquery(query=sub)  # query now holds the BOUND PLAN
+        n.dtype = sub.schema.fields[0].dtype
+        return n
+
+    # --- interval folding ---
+
+    def _fold_intervals(self, e: E.Expr) -> E.Expr:
+        def fold(n: E.Expr) -> E.Expr:
+            if isinstance(n, E.Binary) and n.op in (E.BinOp.ADD, E.BinOp.SUB):
+                l, r = n.left, n.right
+                if isinstance(r, E.Interval):
+                    if isinstance(l, E.Literal) and l.literal_type is T.DATE32:
+                        days = _shift_date(l.value, r,
+                                           negate=(n.op is E.BinOp.SUB))
+                        return E.Literal(value=days, literal_type=T.DATE32)
+                    if r.months == 0:
+                        # non-literal date +/- day interval: plain day arithmetic
+                        d = E.Literal(value=r.days, literal_type=T.INT32)
+                        return E.Binary(op=n.op, left=l, right=d)
+                    raise NotSupportedError(
+                        "month/year intervals require a literal date operand")
+                if isinstance(l, E.Interval):
+                    raise NotSupportedError("interval must be the right operand")
+            return n
+        return E.transform(e, fold)
+
+
+# --- helpers ---------------------------------------------------------------------
+
+def _shift_date(days_since_epoch: int, iv: E.Interval, negate: bool) -> int:
+    d = _dt.date.fromordinal(_EPOCH_ORD + days_since_epoch)
+    months = -iv.months if negate else iv.months
+    day_shift = -iv.days if negate else iv.days
+    if months:
+        total = d.year * 12 + (d.month - 1) + months
+        y, m = divmod(total, 12)
+        import calendar
+        dd = min(d.day, calendar.monthrange(y, m + 1)[1])
+        d = _dt.date(y, m + 1, dd)
+    d = d + _dt.timedelta(days=day_shift)
+    return d.toordinal() - _EPOCH_ORD
+
+
+def _literal_type_of(v) -> T.DataType:
+    if v is None:
+        return T.NULL
+    if isinstance(v, bool):
+        return T.BOOL
+    if isinstance(v, int):
+        return T.INT32 if -(2 ** 31) <= v < 2 ** 31 else T.INT64
+    if isinstance(v, float):
+        return T.FLOAT64
+    if isinstance(v, str):
+        return T.STRING
+    raise PlanError(f"unsupported literal {v!r}")
+
+
+def _check_comparable(left: E.Expr, right: E.Expr, op) -> None:
+    a, b = left.dtype, right.dtype
+    if a.is_string != b.is_string:
+        raise PlanError(f"cannot compare {a} with {b}")
+    if not a.is_string:
+        try:
+            T.common_type(a, b)
+        except TypeError as ex:
+            raise PlanError(str(ex)) from None
+
+
+def _arith_type(left: E.Expr, right: E.Expr, op) -> T.DataType:
+    a, b = left.dtype, right.dtype
+    if a.id == T.TypeId.DATE32 and b.is_integer:
+        return T.DATE32
+    if b.id == T.TypeId.DATE32 and a.is_integer and op is E.BinOp.ADD:
+        return T.DATE32
+    if a.id == T.TypeId.DATE32 and b.id == T.TypeId.DATE32 and op is E.BinOp.SUB:
+        return T.INT32  # date difference in days
+    if not (a.is_numeric or a.id == T.TypeId.NULL) or \
+            not (b.is_numeric or b.id == T.TypeId.NULL):
+        raise PlanError(f"arithmetic on non-numeric types {a}, {b}")
+    if op is E.BinOp.DIV:
+        ct = T.common_type(a, b)
+        return ct
+    return T.common_type(a, b)
+
+
+def _split_conjuncts(e: E.Expr) -> list[E.Expr]:
+    if isinstance(e, E.Binary) and e.op is E.BinOp.AND:
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+_split_conjuncts_ast = _split_conjuncts
+
+
+def _and_all(parts: list[E.Expr]) -> Optional[E.Expr]:
+    if not parts:
+        return None
+    out = parts[0]
+    for p in parts[1:]:
+        n = E.Binary(op=E.BinOp.AND, left=out, right=p)
+        n.dtype = T.BOOL
+        out = n
+    return out
+
+
+def _extract_equi_key(c: E.Expr, n_left: int):
+    """If conjunct is `expr_L = expr_R` with sides fully on left/right of a join
+    (column indices < n_left vs >= n_left), return (left_key, right_key with
+    re-based indices); else None."""
+    if not (isinstance(c, E.Binary) and c.op is E.BinOp.EQ):
+        return None
+
+    def side_of(e):
+        idxs = [n.index for n in E.walk(e) if isinstance(n, E.Column)]
+        if any(isinstance(n, OuterRef) for n in E.walk(e)):
+            return None
+        if not idxs:
+            return "const"
+        if all(i < n_left for i in idxs):
+            return "L"
+        if all(i >= n_left for i in idxs):
+            return "R"
+        return None
+
+    sl, sr = side_of(c.left), side_of(c.right)
+    if sl == "L" and sr == "R":
+        lk, rk = c.left, c.right
+    elif sl == "R" and sr == "L":
+        lk, rk = c.right, c.left
+    else:
+        return None
+    rk = copy.deepcopy(rk)
+    for n in E.walk(rk):
+        if isinstance(n, E.Column):
+            n.index -= n_left
+    return lk, rk
+
+
+def _extract_corr_eq(c: E.Expr):
+    """If conjunct is OuterRef = inner_expr (either order), return
+    (outer_entry, inner_expr); else None."""
+    if not (isinstance(c, E.Binary) and c.op is E.BinOp.EQ):
+        return None
+    l, r = c.left, c.right
+    if isinstance(l, OuterRef) and not any(
+            isinstance(n, OuterRef) for n in E.walk(r)):
+        return (l.entry, r)
+    if isinstance(r, OuterRef) and not any(
+            isinstance(n, OuterRef) for n in E.walk(l)):
+        return (r.entry, l)
+    return None
+
+
+def _expr_eq(a: E.Expr, b: E.Expr) -> bool:
+    """Structural equality of bound expressions (Column compares by index)."""
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, E.Column):
+        return a.index == b.index
+    if isinstance(a, E.Literal):
+        return a.value == b.value and a.literal_type == b.literal_type
+    if isinstance(a, E.Binary):
+        return a.op is b.op and _expr_eq(a.left, b.left) and _expr_eq(a.right, b.right)
+    if isinstance(a, (E.Not, E.Negate)):
+        return _expr_eq(a.operand, b.operand)
+    if isinstance(a, E.IsNull):
+        return a.negated == b.negated and _expr_eq(a.operand, b.operand)
+    if isinstance(a, E.Cast):
+        return a.to == b.to and _expr_eq(a.operand, b.operand)
+    if isinstance(a, E.Aggregate):
+        if a.func is not b.func or a.distinct != b.distinct:
+            return False
+        if (a.arg is None) != (b.arg is None):
+            return False
+        return a.arg is None or _expr_eq(a.arg, b.arg)
+    if isinstance(a, E.Func):
+        return a.name == b.name and len(a.args) == len(b.args) and \
+            all(_expr_eq(x, y) for x, y in zip(a.args, b.args))
+    if isinstance(a, E.Alias):
+        return _expr_eq(a.operand, b.operand)
+    if isinstance(a, E.Case):
+        if len(a.whens) != len(b.whens) or (a.else_ is None) != (b.else_ is None):
+            return False
+        for (c1, v1), (c2, v2) in zip(a.whens, b.whens):
+            if not (_expr_eq(c1, c2) and _expr_eq(v1, v2)):
+                return False
+        return a.else_ is None or _expr_eq(a.else_, b.else_)
+    if isinstance(a, E.InList):
+        return a.negated == b.negated and _expr_eq(a.operand, b.operand) and \
+            len(a.items) == len(b.items) and \
+            all(_expr_eq(x, y) for x, y in zip(a.items, b.items))
+    if isinstance(a, E.Like):
+        return (a.pattern, a.negated, a.case_insensitive) == \
+            (b.pattern, b.negated, b.case_insensitive) and \
+            _expr_eq(a.operand, b.operand)
+    return a is b
+
+
+def _dedup_fields(fields: list[T.Field]) -> list[T.Field]:
+    """Join output schema: rename right-side collisions with a `right_` prefix
+    (parity with the reference's HashJoinExec schema combination,
+    crates/engine/src/operators/hash_join.rs:42-66)."""
+    seen: dict[str, int] = {}
+    out = []
+    for f in fields:
+        name = f.name
+        if name in seen:
+            name = f"right_{name}"
+            k = 1
+            while name in seen:
+                k += 1
+                name = f"right{k}_{f.name}"
+        seen[name] = 1
+        out.append(T.Field(name, f.dtype, f.nullable))
+    return out
+
+
+def _plan_exprs(plan: L.LogicalPlan) -> list[E.Expr]:
+    if isinstance(plan, L.Filter):
+        return [plan.predicate]
+    if isinstance(plan, L.Project):
+        return list(plan.exprs)
+    if isinstance(plan, L.Aggregate):
+        return list(plan.group_exprs) + list(plan.aggs)
+    if isinstance(plan, L.Join):
+        out = list(plan.left_keys) + list(plan.right_keys)
+        if plan.residual is not None:
+            out.append(plan.residual)
+        return out
+    if isinstance(plan, L.Sort):
+        return list(plan.keys)
+    return []
+
+
+def _replace_child(plan: L.LogicalPlan, i: int, new: L.LogicalPlan) -> None:
+    if isinstance(plan, (L.Filter, L.Project, L.Aggregate, L.Sort, L.Limit,
+                         L.Distinct)):
+        plan.input = new
+    elif isinstance(plan, (L.Join, L.SetOpJoin)):
+        if i == 0:
+            plan.left = new
+        else:
+            plan.right = new
+    elif isinstance(plan, L.Union):
+        plan.inputs[i] = new

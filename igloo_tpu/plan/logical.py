@@ -1,0 +1,182 @@
+"""Logical plan IR.
+
+The reference uses DataFusion's `LogicalPlan` and lowers a 4-node subset to its custom
+operators (crates/engine/src/physical_planner.rs:23-140: TableScan/Projection/Filter/
+Join). We own the logical plan — it is the unit the optimizer rewrites, the
+distributed planner fragments, and the executor lowers to fused jit computations.
+
+Every node carries its output `Schema`; expressions inside nodes are *bound*
+(Column.index resolved against the node's input schema, dtypes inferred).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Optional
+
+from igloo_tpu.types import Schema
+from igloo_tpu.plan import expr as E
+from igloo_tpu.sql.ast import JoinType
+
+if TYPE_CHECKING:  # pragma: no cover
+    from igloo_tpu.catalog import TableProvider
+
+
+@dataclass
+class LogicalPlan:
+    schema: Schema = field(default=None, init=False)  # type: ignore[assignment]
+
+    def children(self) -> list["LogicalPlan"]:
+        return []
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class Scan(LogicalPlan):
+    """Table scan. `projection` (column names) is filled by projection pruning;
+    `pushed_filters` by predicate pushdown (connector may evaluate them early —
+    unlike the reference, which ignores the provider and hardcodes a path,
+    physical_planner.rs:37-39 / gap G5, the provider here is authoritative)."""
+    table: str = ""
+    provider: object = None  # TableProvider
+    projection: Optional[list[str]] = None
+    pushed_filters: list[E.Expr] = field(default_factory=list)
+
+    def node_name(self):
+        cols = f" cols={self.projection}" if self.projection is not None else ""
+        return f"Scan({self.table}{cols})"
+
+
+@dataclass
+class Filter(LogicalPlan):
+    input: LogicalPlan = None  # type: ignore[assignment]
+    predicate: E.Expr = None   # type: ignore[assignment]
+
+    def children(self):
+        return [self.input]
+
+    def node_name(self):
+        return f"Filter({self.predicate!r})"
+
+
+@dataclass
+class Project(LogicalPlan):
+    input: LogicalPlan = None          # type: ignore[assignment]
+    exprs: list[E.Expr] = field(default_factory=list)
+    names: list[str] = field(default_factory=list)
+
+    def children(self):
+        return [self.input]
+
+    def node_name(self):
+        return f"Project({', '.join(self.names)})"
+
+
+@dataclass
+class Aggregate(LogicalPlan):
+    """Group-by + aggregate. Output schema = group columns then aggregate columns."""
+    input: LogicalPlan = None  # type: ignore[assignment]
+    group_exprs: list[E.Expr] = field(default_factory=list)
+    group_names: list[str] = field(default_factory=list)
+    aggs: list[E.Aggregate] = field(default_factory=list)
+    agg_names: list[str] = field(default_factory=list)
+
+    def children(self):
+        return [self.input]
+
+    def node_name(self):
+        return f"Aggregate(by=[{', '.join(self.group_names)}], aggs=[{', '.join(self.agg_names)}])"
+
+
+@dataclass
+class Join(LogicalPlan):
+    """Equi-join with optional residual filter (bound against concat(left, right)
+    schema). CROSS join = empty key lists. Completes the reference's partial
+    HashJoinExec (G4: right/full outer unmatched rows are emitted here)."""
+    left: LogicalPlan = None   # type: ignore[assignment]
+    right: LogicalPlan = None  # type: ignore[assignment]
+    join_type: JoinType = JoinType.INNER
+    left_keys: list[E.Expr] = field(default_factory=list)
+    right_keys: list[E.Expr] = field(default_factory=list)
+    residual: Optional[E.Expr] = None  # non-equi part of ON
+
+    def children(self):
+        return [self.left, self.right]
+
+    def node_name(self):
+        return f"Join({self.join_type.value}, on={len(self.left_keys)} keys{', residual' if self.residual else ''})"
+
+
+@dataclass
+class Sort(LogicalPlan):
+    input: LogicalPlan = None  # type: ignore[assignment]
+    keys: list[E.Expr] = field(default_factory=list)  # bound against input schema
+    ascending: list[bool] = field(default_factory=list)
+    nulls_first: list[bool] = field(default_factory=list)
+
+    def children(self):
+        return [self.input]
+
+    def node_name(self):
+        return f"Sort({len(self.keys)} keys)"
+
+
+@dataclass
+class Limit(LogicalPlan):
+    input: LogicalPlan = None  # type: ignore[assignment]
+    limit: Optional[int] = None
+    offset: int = 0
+
+    def children(self):
+        return [self.input]
+
+    def node_name(self):
+        return f"Limit({self.limit}, offset={self.offset})"
+
+
+@dataclass
+class Distinct(LogicalPlan):
+    input: LogicalPlan = None  # type: ignore[assignment]
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass
+class Union(LogicalPlan):
+    """UNION ALL (bag union). Set-union is Distinct(Union)."""
+    inputs: list[LogicalPlan] = field(default_factory=list)
+
+    def children(self):
+        return list(self.inputs)
+
+
+@dataclass
+class SetOpJoin(LogicalPlan):
+    """INTERSECT / EXCEPT as distinct + semi/anti join on all columns."""
+    left: LogicalPlan = None   # type: ignore[assignment]
+    right: LogicalPlan = None  # type: ignore[assignment]
+    anti: bool = False         # False=INTERSECT, True=EXCEPT
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclass
+class Values(LogicalPlan):
+    """Inline literal rows (VALUES ... / SELECT-without-FROM one-row source)."""
+    rows: list[list[object]] = field(default_factory=list)  # python values
+
+
+def plan_tree_str(plan: LogicalPlan, indent: int = 0) -> str:
+    lines = ["  " * indent + plan.node_name()]
+    for c in plan.children():
+        lines.append(plan_tree_str(c, indent + 1))
+    return "\n".join(lines)
+
+
+def walk_plan(plan: LogicalPlan):
+    yield plan
+    for c in plan.children():
+        yield from walk_plan(c)

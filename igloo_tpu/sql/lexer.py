@@ -9,6 +9,14 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass
 
+from igloo_tpu.errors import SqlParseError
+
+
+def line_col(sql: str, pos: int) -> tuple[int, int]:
+    line = sql.count("\n", 0, pos) + 1
+    col = pos - (sql.rfind("\n", 0, pos) + 1) + 1
+    return line, col
+
 
 class Tok(enum.Enum):
     IDENT = "ident"
@@ -29,10 +37,9 @@ class Token:
         return self.text.upper()
 
 
-class SqlLexError(Exception):
+class SqlLexError(SqlParseError):
     def __init__(self, msg: str, sql: str, pos: int):
-        line = sql.count("\n", 0, pos) + 1
-        col = pos - (sql.rfind("\n", 0, pos) + 1) + 1
+        line, col = line_col(sql, pos)
         super().__init__(f"{msg} at line {line}, column {col}")
 
 
@@ -77,19 +84,22 @@ def tokenize(sql: str) -> list[Token]:
             toks.append(Token(Tok.STRING, "".join(buf), i))
             i = j + 1
             continue
-        # quoted identifier
-        if c == '"':
-            j = sql.find('"', i + 1)
-            if j < 0:
-                raise SqlLexError("unterminated quoted identifier", sql, i)
-            toks.append(Token(Tok.QIDENT, sql[i + 1: j], i))
-            i = j + 1
-            continue
-        if c == "`":
-            j = sql.find("`", i + 1)
-            if j < 0:
-                raise SqlLexError("unterminated quoted identifier", sql, i)
-            toks.append(Token(Tok.QIDENT, sql[i + 1: j], i))
+        # quoted identifier ("" / `` doubling escapes the quote char)
+        if c in ('"', "`"):
+            j = i + 1
+            buf = []
+            while True:
+                if j >= n:
+                    raise SqlLexError("unterminated quoted identifier", sql, i)
+                if sql[j] == c:
+                    if j + 1 < n and sql[j + 1] == c:
+                        buf.append(c)
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            toks.append(Token(Tok.QIDENT, "".join(buf), i))
             i = j + 1
             continue
         # number: digits, optional fraction/exponent; also ".5"

@@ -64,6 +64,14 @@ class DataType:
         """numpy dtype of the on-device lane for this logical type."""
         return np.dtype(_DEVICE_DTYPE[self.id])
 
+    # immutable singletons: keep identity across copy/deepcopy so `is` checks and
+    # expression deep-copies in the binder stay cheap and correct
+    def __copy__(self) -> "DataType":
+        return self
+
+    def __deepcopy__(self, memo) -> "DataType":
+        return self
+
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return self.id.value
 

@@ -1,0 +1,185 @@
+"""Binder tests: AST -> bound logical plan (name resolution, typing, aggregate
+hoisting, subquery rewrites)."""
+import pyarrow as pa
+import pytest
+
+from igloo_tpu import types as T
+from igloo_tpu.catalog import Catalog, MemTable
+from igloo_tpu.errors import PlanError
+from igloo_tpu.plan import expr as E
+from igloo_tpu.plan import logical as L
+from igloo_tpu.plan.binder import Binder
+from igloo_tpu.sql.parser import parse_sql
+
+
+@pytest.fixture
+def catalog():
+    c = Catalog()
+    c.register("t", MemTable.from_pydict({
+        "a": pa.array([1, 2, 3], type=pa.int64()),
+        "b": pa.array([1.5, 2.5, 3.5]),
+        "s": pa.array(["x", "y", "z"]),
+    }))
+    c.register("u", MemTable.from_pydict({
+        "a": pa.array([1, 2], type=pa.int64()),
+        "c": pa.array([10, 20], type=pa.int64()),
+    }))
+    return c
+
+
+def bind(catalog, sql):
+    return Binder(catalog).bind(parse_sql(sql))
+
+
+def test_simple_select(catalog):
+    plan = bind(catalog, "SELECT a, b + 1 AS b1 FROM t WHERE a > 1")
+    assert isinstance(plan, L.Project)
+    assert plan.schema.names == ["a", "b1"]
+    assert plan.schema.fields[0].dtype is T.INT64
+    assert plan.schema.fields[1].dtype is T.FLOAT64
+    assert isinstance(plan.input, L.Filter)
+    assert isinstance(plan.input.input, L.Scan)
+
+
+def test_unknown_column(catalog):
+    with pytest.raises(PlanError, match="column not found"):
+        bind(catalog, "SELECT zzz FROM t")
+
+
+def test_ambiguous_column(catalog):
+    with pytest.raises(PlanError, match="ambiguous"):
+        bind(catalog, "SELECT a FROM t JOIN u ON t.a = u.a")
+
+
+def test_star_expansion(catalog):
+    plan = bind(catalog, "SELECT * FROM t")
+    assert plan.schema.names == ["a", "b", "s"]
+    plan = bind(catalog, "SELECT t.*, u.c FROM t JOIN u ON t.a = u.a")
+    assert plan.schema.names == ["a", "b", "s", "c"]
+
+
+def test_join_key_extraction(catalog):
+    plan = bind(catalog, "SELECT t.a FROM t JOIN u ON t.a = u.a AND t.a > u.c")
+    join = plan.input
+    assert isinstance(join, L.Join)
+    assert len(join.left_keys) == 1
+    assert join.residual is not None
+    # join output dedups colliding names with right_ prefix
+    assert "right_a" in join.schema.names
+
+
+def test_aggregate_hoisting(catalog):
+    plan = bind(catalog, """
+        SELECT s, sum(a) AS total, sum(a) / count(*) AS avg_a
+        FROM t GROUP BY s HAVING count(*) > 0
+    """)
+    assert isinstance(plan, L.Project)
+    filt = plan.input
+    assert isinstance(filt, L.Filter)
+    agg = filt.input
+    assert isinstance(agg, L.Aggregate)
+    assert len(agg.aggs) == 2  # sum(a) deduped, count(*) once
+    assert agg.schema.names[0] == "s"
+    assert plan.schema.names == ["s", "total", "avg_a"]
+    assert plan.schema.fields[1].dtype is T.INT64
+
+
+def test_group_by_ordinal_and_alias(catalog):
+    plan = bind(catalog, "SELECT s AS grp, count(*) FROM t GROUP BY 1")
+    agg = plan.input
+    assert isinstance(agg, L.Aggregate)
+    assert len(agg.group_exprs) == 1
+    plan2 = bind(catalog, "SELECT s AS grp, count(*) FROM t GROUP BY grp")
+    assert isinstance(plan2.input, L.Aggregate)
+
+
+def test_non_grouped_column_rejected(catalog):
+    with pytest.raises(PlanError, match="GROUP BY"):
+        bind(catalog, "SELECT a, count(*) FROM t GROUP BY s")
+
+
+def test_global_aggregate(catalog):
+    plan = bind(catalog, "SELECT count(*), sum(b) FROM t")
+    agg = plan.input
+    assert isinstance(agg, L.Aggregate)
+    assert agg.group_exprs == []
+
+
+def test_order_by_hidden_column(catalog):
+    plan = bind(catalog, "SELECT a FROM t ORDER BY b DESC")
+    # Sort on hidden col, then a narrowing projection drops it
+    assert isinstance(plan, L.Project)
+    assert plan.schema.names == ["a"]
+    assert isinstance(plan.input, L.Sort)
+    assert plan.input.ascending == [False]
+
+
+def test_order_by_output_name(catalog):
+    plan = bind(catalog, "SELECT a AS x FROM t ORDER BY x")
+    assert isinstance(plan, L.Sort)
+
+
+def test_in_subquery_becomes_semi_join(catalog):
+    plan = bind(catalog, "SELECT a FROM t WHERE a IN (SELECT a FROM u)")
+    join = plan.input
+    assert isinstance(join, L.Join)
+    assert join.join_type.value == "semi"
+    plan = bind(catalog, "SELECT a FROM t WHERE a NOT IN (SELECT a FROM u)")
+    assert plan.input.join_type.value == "anti"
+
+
+def test_correlated_exists(catalog):
+    plan = bind(catalog, """
+        SELECT a FROM t WHERE EXISTS (SELECT 1 FROM u WHERE u.a = t.a AND u.c > 5)
+    """)
+    join = plan.input
+    assert isinstance(join, L.Join)
+    assert join.join_type.value == "semi"
+    assert len(join.left_keys) == 1  # correlation key
+
+
+def test_uncorrelated_scalar_subquery(catalog):
+    plan = bind(catalog, "SELECT a FROM t WHERE b > (SELECT sum(c) FROM u)")
+    filt = plan.input
+    assert isinstance(filt, L.Filter)
+    subs = [n for n in E.walk(filt.predicate) if isinstance(n, E.ScalarSubquery)]
+    assert len(subs) == 1
+    assert isinstance(subs[0].query, L.LogicalPlan)  # bound plan spliced in
+
+
+def test_union_types_unify(catalog):
+    plan = bind(catalog, "SELECT a FROM t UNION ALL SELECT c FROM u")
+    assert isinstance(plan, L.Union)
+    assert plan.schema.fields[0].dtype is T.INT64
+    plan = bind(catalog, "SELECT a FROM t UNION SELECT c FROM u")
+    assert isinstance(plan, L.Distinct)
+
+
+def test_cte(catalog):
+    plan = bind(catalog, "WITH big AS (SELECT a FROM t WHERE a > 1) "
+                         "SELECT * FROM big")
+    assert plan.schema.names == ["a"]
+
+
+def test_using_join_outputs_single_key(catalog):
+    plan = bind(catalog, "SELECT * FROM t JOIN u USING (a)")
+    assert plan.schema.names == ["a", "b", "s", "c"]
+
+
+def test_interval_folding(catalog):
+    plan = bind(catalog, "SELECT a FROM t WHERE "
+                         "CAST(a AS DATE) <= DATE '1998-12-01' - INTERVAL '90' DAY")
+    filt = plan.input
+    lits = [n for n in E.walk(filt.predicate) if isinstance(n, E.Literal)]
+    assert any(lit.literal_type is T.DATE32 for lit in lits)
+
+
+def test_values(catalog):
+    plan = bind(catalog, "VALUES (1, 'a'), (2, 'b')")
+    assert isinstance(plan, L.Project)
+    assert [f.dtype for f in plan.schema] == [T.INT32, T.STRING]
+
+
+def test_where_type_check(catalog):
+    with pytest.raises(PlanError, match="boolean"):
+        bind(catalog, "SELECT a FROM t WHERE a + 1")

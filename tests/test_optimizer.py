@@ -1,0 +1,130 @@
+"""Optimizer pass tests: constant folding, predicate pushdown, projection pruning."""
+import pyarrow as pa
+import pytest
+
+from igloo_tpu.catalog import Catalog, MemTable
+from igloo_tpu.plan import expr as E
+from igloo_tpu.plan import logical as L
+from igloo_tpu.plan.binder import Binder
+from igloo_tpu.plan.optimizer import optimize
+from igloo_tpu.sql.parser import parse_sql
+
+
+@pytest.fixture
+def catalog():
+    c = Catalog()
+    c.register("t", MemTable.from_pydict({
+        "a": pa.array([1, 2, 3], type=pa.int64()),
+        "b": pa.array([1.5, 2.5, 3.5]),
+        "s": pa.array(["x", "y", "z"]),
+        "d": pa.array([10, 20, 30], type=pa.int64()),
+    }))
+    c.register("u", MemTable.from_pydict({
+        "k": pa.array([1, 2], type=pa.int64()),
+        "v": pa.array([10, 20], type=pa.int64()),
+    }))
+    return c
+
+
+def plan_for(catalog, sql):
+    return optimize(Binder(catalog).bind(parse_sql(sql)))
+
+
+def find(plan, cls):
+    return [n for n in L.walk_plan(plan) if isinstance(n, cls)]
+
+
+def test_constant_folding(catalog):
+    plan = plan_for(catalog, "SELECT a FROM t WHERE a > 1 + 2 * 3")
+    filt = find(plan, L.Filter)[0]
+    lits = [n for n in E.walk(filt.predicate) if isinstance(n, E.Literal)]
+    assert any(lit.value == 7 for lit in lits)
+
+
+def test_true_filter_removed(catalog):
+    plan = plan_for(catalog, "SELECT a FROM t WHERE 1 = 1")
+    assert not find(plan, L.Filter)
+
+
+def test_pushdown_through_project(catalog):
+    plan = plan_for(catalog, "SELECT * FROM (SELECT a + 1 AS a1, b FROM t) WHERE a1 > 2")
+    # filter sinks below the inner projection, substituted to a + 1 > 2
+    filters = find(plan, L.Filter)
+    assert filters
+    f = filters[-1]
+    assert isinstance(f.input, L.Scan)
+    cols = [n.name for n in E.walk(f.predicate) if isinstance(n, E.Column)]
+    assert cols == ["a"]
+
+
+def test_pushdown_to_both_join_sides(catalog):
+    plan = plan_for(catalog, """
+        SELECT t.a, u.v FROM t JOIN u ON t.a = u.k
+        WHERE t.b > 2 AND u.v < 15
+    """)
+    join = find(plan, L.Join)[0]
+    assert isinstance(join.left, L.Filter)
+    assert isinstance(join.right, L.Filter)
+
+
+def test_left_join_right_filter_not_pushed(catalog):
+    plan = plan_for(catalog, """
+        SELECT t.a FROM t LEFT JOIN u ON t.a = u.k WHERE u.v < 15
+    """)
+    join = find(plan, L.Join)[0]
+    # right-side predicate must stay above the join (it filters null-extended rows)
+    assert not isinstance(join.right, L.Filter)
+
+
+def test_scan_receives_pushed_filters(catalog):
+    plan = plan_for(catalog, "SELECT a FROM t WHERE a > 1")
+    scan = find(plan, L.Scan)[0]
+    assert len(scan.pushed_filters) == 1
+
+
+def test_projection_pruning(catalog):
+    plan = plan_for(catalog, "SELECT a FROM t WHERE b > 2")
+    scan = find(plan, L.Scan)[0]
+    assert scan.projection is not None
+    assert set(scan.projection) == {"a", "b"}  # s and d pruned
+
+
+def test_pruning_through_join(catalog):
+    plan = plan_for(catalog, "SELECT u.v FROM t JOIN u ON t.a = u.k")
+    scans = {s.table: s for s in find(plan, L.Scan)}
+    assert scans["t"].projection == ["a"]
+    assert scans["u"].projection is None  # u needs both its columns: no pruning
+
+
+def test_pruning_aggregate(catalog):
+    plan = plan_for(catalog, "SELECT s, sum(a) FROM t GROUP BY s")
+    scan = find(plan, L.Scan)[0]
+    assert set(scan.projection) == {"a", "s"}
+
+
+def test_pushdown_below_aggregate_on_group_cols(catalog):
+    plan = plan_for(catalog, """
+        SELECT s, count(*) AS c FROM t GROUP BY s HAVING s = 'x' AND count(*) > 0
+    """)
+    agg = find(plan, L.Aggregate)[0]
+    # the s='x' conjunct sinks below the aggregate; count(*)>0 stays above
+    below = find(agg.input, L.Filter)
+    assert below
+    above = [f for f in find(plan, L.Filter) if f not in below]
+    assert above
+
+
+def test_limit_blocks_pushdown(catalog):
+    plan = plan_for(catalog,
+                    "SELECT * FROM (SELECT a FROM t LIMIT 2) q WHERE a > 1")
+    lim = find(plan, L.Limit)[0]
+    # the filter must remain above the limit
+    assert not find(lim.input, L.Filter)
+
+
+def test_schema_preserved(catalog):
+    sql = "SELECT s, sum(a) AS tot FROM t WHERE b > 1 GROUP BY s ORDER BY tot DESC LIMIT 5"
+    bound = Binder(catalog).bind(parse_sql(sql))
+    names_before = bound.schema.names
+    opt = optimize(bound)
+    assert opt.schema.names == names_before
