@@ -1,0 +1,6 @@
+"""`python -m igloo_tpu` == the igloo CLI binary."""
+import sys
+
+from igloo_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,138 @@
+"""igloo CLI.
+
+Parity with the reference binary (crates/igloo/src/main.rs:9-20: --sql, --config,
+--distributed) plus --device/--explain/--timing, an interactive REPL when no --sql
+is given, and the same demo UX: with no tables configured, a sample `users` table
+is registered (main.rs:59-77). Unlike the reference (gap G3: --distributed
+silently falls back to local, main.rs:97-100), --distributed here really connects
+to a coordinator and errors loudly when it cannot.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import pyarrow as pa
+
+
+def sample_users_table() -> pa.Table:
+    # mirrors the reference CLI's in-memory demo table (main.rs:59-77)
+    return pa.table({
+        "id": pa.array([1, 2, 3, 4, 5], type=pa.int64()),
+        "name": ["alice", "bob", "carol", "dave", "eve"],
+        "age": pa.array([30, 25, 35, 28, 40], type=pa.int64()),
+    })
+
+
+def build_engine(cfg, use_jit: bool = True):
+    from igloo_tpu.config import make_provider
+    from igloo_tpu.engine import QueryEngine
+    engine = QueryEngine(use_jit=use_jit)
+    registered = False
+    if cfg is not None:
+        for t in cfg.tables:
+            engine.register_table(t.name, make_provider(t))
+            registered = True
+    if not registered:
+        engine.register_table("users", sample_users_table())
+    return engine
+
+
+def _print_table(t: pa.Table, limit: int = 100) -> None:
+    if t.num_rows > limit:
+        shown = t.slice(0, limit)
+        print(shown.to_pandas().to_string(index=False))
+        print(f"... ({t.num_rows} rows total, showing {limit})")
+    else:
+        print(t.to_pandas().to_string(index=False))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="igloo",
+        description="igloo-tpu: TPU-native distributed SQL engine")
+    ap.add_argument("--sql", help="SQL to execute (omit for a REPL)")
+    ap.add_argument("--config", help="TOML config file")
+    ap.add_argument("--distributed", action="store_true",
+                    help="execute through a coordinator (requires a running "
+                         "cluster; see igloo-coordinator / igloo-worker)")
+    ap.add_argument("--coordinator", default=None,
+                    help="coordinator address host:port for --distributed")
+    ap.add_argument("--device", choices=["auto", "tpu", "cpu"], default="auto")
+    ap.add_argument("--no-jit", action="store_true",
+                    help="run kernels eagerly (debugging)")
+    ap.add_argument("--timing", action="store_true",
+                    help="print per-stage timing spans")
+    args = ap.parse_args(argv)
+
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    elif args.device == "tpu":
+        os.environ.setdefault("JAX_PLATFORMS", "tpu")
+
+    from igloo_tpu.config import Config
+    from igloo_tpu.errors import IglooError
+    from igloo_tpu.utils import tracing
+
+    cfg = Config.load(args.config) if args.config else None
+
+    if args.distributed:
+        # no silent local fallback (reference gap G3): distributed means
+        # distributed, and failure to reach the cluster is an error
+        from igloo_tpu.cluster.client import DistributedClient
+        addr = args.coordinator
+        if addr is None and cfg is not None:
+            addr = f"{cfg.cluster.coordinator_host}:{cfg.cluster.coordinator_port}"
+        if addr is None:
+            addr = "127.0.0.1:50051"
+        try:
+            client = DistributedClient(addr)
+            client.ping()
+        except Exception as ex:
+            print(f"error: cannot reach coordinator at {addr}: {ex}",
+                  file=sys.stderr)
+            return 2
+        runner = client.execute
+    else:
+        engine = build_engine(cfg, use_jit=not args.no_jit)
+        runner = engine.execute
+
+    def run_one(sql: str) -> int:
+        try:
+            tracing.reset()
+            result = runner(sql)
+            _print_table(result)
+            if args.timing:
+                print(tracing.last_trace(), file=sys.stderr)
+            return 0
+        except IglooError as ex:
+            print(f"error: {ex}", file=sys.stderr)
+            return 1
+
+    if args.sql:
+        return run_one(args.sql)
+
+    # REPL
+    print("igloo-tpu SQL shell — \\q to quit")
+    buf = []
+    while True:
+        try:
+            prompt = "igloo> " if not buf else "   ... "
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if line.strip() in ("\\q", "quit", "exit"):
+            return 0
+        buf.append(line)
+        if line.rstrip().endswith(";") or (len(buf) == 1 and line.strip() and
+                                           not line.rstrip().endswith(",")):
+            sql = "\n".join(buf).rstrip().rstrip(";")
+            buf = []
+            if sql.strip():
+                run_one(sql)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""Configuration.
+
+The reference accepts `--config <path>` and ignores it (crates/igloo/src/main.rs:
+36-40, gap in §5.6); ours is real: TOML with tables to register, device/mesh
+settings, cache budget, and cluster addresses (the hardcoded 127.0.0.1:5005x pair
+in the reference's daemons becomes configuration here).
+"""
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Optional
+
+from igloo_tpu.errors import IglooError
+
+
+@dataclass
+class TableConfig:
+    name: str
+    path: str
+    format: str = "parquet"        # parquet | csv | iceberg
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class ClusterConfig:
+    coordinator_host: str = "127.0.0.1"
+    coordinator_port: int = 50051
+    worker_host: str = "127.0.0.1"
+    worker_port: int = 50052
+    flight_port: int = 50055
+    heartbeat_interval_s: float = 5.0
+    # liveness: evict workers silent for this long (reference records last_seen
+    # but never acts on it — gap G6)
+    worker_timeout_s: float = 15.0
+
+
+@dataclass
+class Config:
+    tables: list[TableConfig] = field(default_factory=list)
+    device: str = "auto"           # auto | tpu | cpu
+    mesh_shape: Optional[list[int]] = None
+    mesh_axes: list[str] = field(default_factory=lambda: ["data"])
+    cache_budget_bytes: int = 1 << 30
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    use_jit: bool = True
+
+    @staticmethod
+    def load(path: str) -> "Config":
+        if not os.path.exists(path):
+            raise IglooError(f"config file not found: {path}")
+        with open(path, "rb") as fh:
+            raw = tomllib.load(fh)
+        cfg = Config()
+        for t in raw.get("tables", []):
+            if "name" not in t or "path" not in t:
+                raise IglooError("each [[tables]] entry needs name and path")
+            cfg.tables.append(TableConfig(
+                name=t["name"], path=t["path"],
+                format=t.get("format", "parquet"),
+                options={k: v for k, v in t.items()
+                         if k not in ("name", "path", "format")}))
+        eng = raw.get("engine", {})
+        cfg.device = eng.get("device", cfg.device)
+        cfg.mesh_shape = eng.get("mesh_shape", cfg.mesh_shape)
+        cfg.mesh_axes = eng.get("mesh_axes", cfg.mesh_axes)
+        cfg.cache_budget_bytes = eng.get("cache_budget_bytes",
+                                         cfg.cache_budget_bytes)
+        cfg.use_jit = eng.get("use_jit", cfg.use_jit)
+        cl = raw.get("cluster", {})
+        for k in ("coordinator_host", "coordinator_port", "worker_host",
+                  "worker_port", "flight_port", "heartbeat_interval_s",
+                  "worker_timeout_s"):
+            if k in cl:
+                setattr(cfg.cluster, k, cl[k])
+        return cfg
+
+
+def make_provider(t: TableConfig):
+    if t.format == "parquet":
+        from igloo_tpu.connectors.parquet import ParquetTable
+        return ParquetTable(t.path)
+    if t.format == "csv":
+        from igloo_tpu.connectors.csv import CsvTable
+        return CsvTable(t.path, **t.options)
+    if t.format == "iceberg":
+        from igloo_tpu.connectors.iceberg import IcebergTable
+        return IcebergTable(t.path)
+    raise IglooError(f"unknown table format {t.format!r}")

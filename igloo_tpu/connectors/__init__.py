@@ -1,0 +1,19 @@
+"""Connectors: table providers for external data sources (reference
+crates/connectors/*: filesystem/iceberg working, postgres/mysql stubs — all real
+here)."""
+from igloo_tpu.connectors.csv import CsvTable  # noqa: F401
+from igloo_tpu.connectors.parquet import ParquetTable  # noqa: F401
+
+__all__ = ["CsvTable", "ParquetTable", "IcebergTable", "DbApiTable",
+           "PostgresTable", "MySqlTable"]
+
+
+def __getattr__(name):
+    # lazy: avro/iceberg/dbapi pull extra machinery only when used
+    if name == "IcebergTable":
+        from igloo_tpu.connectors.iceberg import IcebergTable
+        return IcebergTable
+    if name in ("DbApiTable", "PostgresTable", "MySqlTable"):
+        from igloo_tpu.connectors import dbapi
+        return getattr(dbapi, name)
+    raise AttributeError(name)

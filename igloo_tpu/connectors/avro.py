@@ -1,0 +1,200 @@
+"""Minimal Apache Avro container-file reader (read-only, schema-driven).
+
+Iceberg manifest lists and manifest files are Avro; no Avro library is available
+in this environment, so this implements the subset of the Avro 1.x spec those
+files use: the object container format (magic `Obj\\x01`, metadata map with
+embedded writer schema JSON, sync-marker-delimited blocks; null/deflate codecs)
+and the binary encoding for records, unions, arrays, maps, and primitives.
+
+This is what lets the Iceberg connector read REAL table metadata instead of
+globbing for parquet like the reference does (crates/connectors/iceberg/src/
+lib.rs:42-76, module doc: "basic implementation").
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+
+from igloo_tpu.errors import ConnectorError
+
+MAGIC = b"Obj\x01"
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise ConnectorError("avro: truncated data")
+        out = self.buf[self.pos: self.pos + n]
+        self.pos += n
+        return out
+
+    def at_end(self) -> bool:
+        return self.pos >= len(self.buf)
+
+    # --- primitives (avro binary encoding) ---
+
+    def zigzag_long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.read(1)[0]
+            acc |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+            if shift > 70:
+                raise ConnectorError("avro: varint too long")
+        return (acc >> 1) ^ -(acc & 1)
+
+    def a_null(self, schema=None):
+        return None
+
+    def a_boolean(self, schema=None):
+        return self.read(1) != b"\x00"
+
+    def a_int(self, schema=None):
+        return self.zigzag_long()
+
+    a_long = a_int
+
+    def a_float(self, schema=None):
+        return struct.unpack("<f", self.read(4))[0]
+
+    def a_double(self, schema=None):
+        return struct.unpack("<d", self.read(8))[0]
+
+    def a_bytes(self, schema=None):
+        n = self.zigzag_long()
+        return self.read(n)
+
+    def a_string(self, schema=None):
+        return self.a_bytes().decode("utf-8")
+
+    def a_fixed(self, schema):
+        return self.read(schema["size"])
+
+    def a_enum(self, schema):
+        idx = self.zigzag_long()
+        return schema["symbols"][idx]
+
+    # --- compound ---
+
+    def decode(self, schema, named: dict):
+        if isinstance(schema, str):
+            if schema in named:
+                return self.decode(named[schema], named)
+            m = getattr(self, "a_" + schema, None)
+            if m is None:
+                raise ConnectorError(f"avro: unknown type {schema!r}")
+            return m()
+        if isinstance(schema, list):  # union
+            idx = self.zigzag_long()
+            if not (0 <= idx < len(schema)):
+                raise ConnectorError("avro: bad union branch")
+            return self.decode(schema[idx], named)
+        t = schema["type"]
+        if t == "record":
+            out = {}
+            for f in schema["fields"]:
+                out[f["name"]] = self.decode(f["type"], named)
+            return out
+        if t == "array":
+            out = []
+            while True:
+                n = self.zigzag_long()
+                if n == 0:
+                    break
+                if n < 0:
+                    self.zigzag_long()  # block byte size, unused
+                    n = -n
+                for _ in range(n):
+                    out.append(self.decode(schema["items"], named))
+            return out
+        if t == "map":
+            out = {}
+            while True:
+                n = self.zigzag_long()
+                if n == 0:
+                    break
+                if n < 0:
+                    self.zigzag_long()
+                    n = -n
+                for _ in range(n):
+                    k = self.a_string()
+                    out[k] = self.decode(schema["values"], named)
+            return out
+        if t == "fixed":
+            return self.a_fixed(schema)
+        if t == "enum":
+            return self.a_enum(schema)
+        # logical types / aliased primitives fall through to base type
+        m = getattr(self, "a_" + t, None)
+        if m is None:
+            raise ConnectorError(f"avro: unknown complex type {t!r}")
+        return m(schema)
+
+
+def _collect_named(schema, named: dict):
+    if isinstance(schema, dict):
+        t = schema.get("type")
+        if t in ("record", "fixed", "enum") and "name" in schema:
+            named[schema["name"]] = schema
+        if t == "record":
+            for f in schema.get("fields", []):
+                _collect_named(f["type"], named)
+        elif t == "array":
+            _collect_named(schema.get("items"), named)
+        elif t == "map":
+            _collect_named(schema.get("values"), named)
+    elif isinstance(schema, list):
+        for s in schema:
+            _collect_named(s, named)
+
+
+def read_avro_file(path: str) -> list[dict]:
+    """Read all records of an Avro object container file as dicts."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    r = _Reader(data)
+    if r.read(4) != MAGIC:
+        raise ConnectorError(f"not an avro file: {path}")
+    meta = {}
+    while True:
+        n = r.zigzag_long()
+        if n == 0:
+            break
+        if n < 0:
+            r.zigzag_long()
+            n = -n
+        for _ in range(n):
+            k = r.a_string()
+            meta[k] = r.a_bytes()
+    sync = r.read(16)
+    schema = json.loads(meta[b"avro.schema".decode()]
+                        if isinstance(meta.get("avro.schema"), str)
+                        else meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode() \
+        if isinstance(meta.get("avro.codec", b"null"), bytes) else "null"
+    named: dict = {}
+    _collect_named(schema, named)
+    records = []
+    while not r.at_end():
+        count = r.zigzag_long()
+        nbytes = r.zigzag_long()
+        block = r.read(nbytes)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ConnectorError(f"avro codec {codec!r} not supported")
+        br = _Reader(block)
+        for _ in range(count):
+            records.append(br.decode(schema, named))
+        if r.read(16) != sync:
+            raise ConnectorError(f"avro: bad sync marker in {path}")
+    return records

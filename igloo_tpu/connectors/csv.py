@@ -1,0 +1,84 @@
+"""CSV connector.
+
+Counterpart of the reference's filesystem connector (crates/connectors/filesystem/
+src/lib.rs:12-46), which reads a whole CSV into Vec<Vec<String>> under its own
+private TableProvider trait, disconnected from the engine. Ours implements the
+ENGINE's provider protocol (typed arrow decode via pyarrow's C++ CSV reader, and
+the coordinator's ListingTable fixture use-case, coordinator/src/main.rs:26-45).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+
+from igloo_tpu.errors import ConnectorError
+from igloo_tpu.exec.batch import schema_from_arrow
+from igloo_tpu.types import Schema
+
+
+class CsvTable:
+    def __init__(self, path: str, has_header: bool = True,
+                 delimiter: str = ","):
+        self.path = path
+        self.has_header = has_header
+        self.delimiter = delimiter
+        self._files = _expand(path)
+        if not self._files:
+            raise ConnectorError(f"no csv files at {path}")
+        self._schema_arrow = self._read_file(self._files[0]).schema
+        self._schema = schema_from_arrow(self._schema_arrow)
+
+    def _read_opts(self):
+        if self.has_header:
+            ropts = pacsv.ReadOptions()
+        else:
+            # peek at first line for column count
+            with open(self._files[0], "r", encoding="utf-8") as fh:
+                first = fh.readline()
+            n = len(first.rstrip("\n").split(self.delimiter))
+            ropts = pacsv.ReadOptions(
+                column_names=[f"column_{i + 1}" for i in range(n)])
+        return ropts
+
+    def _read_file(self, path: str) -> pa.Table:
+        try:
+            return pacsv.read_csv(
+                path, read_options=self._read_opts(),
+                parse_options=pacsv.ParseOptions(delimiter=self.delimiter))
+        except FileNotFoundError:
+            raise ConnectorError(f"csv file not found: {path}") from None
+        except pa.ArrowInvalid as ex:
+            raise ConnectorError(f"csv parse failed for {path}: {ex}") from None
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return len(self._files)
+
+    def read(self, projection: Optional[list[str]] = None,
+             filters: Optional[list] = None) -> pa.Table:
+        tables = [self._read_file(f) for f in self._files]
+        t = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        if projection is not None:
+            t = t.select(projection)
+        return t
+
+    def read_partition(self, index: int, projection=None, filters=None):
+        t = self._read_file(self._files[index])
+        if projection is not None:
+            t = t.select(projection)
+        return t
+
+
+def _expand(path: str) -> list[str]:
+    if os.path.isdir(path):
+        return sorted(_glob.glob(os.path.join(path, "**", "*.csv"),
+                                 recursive=True))
+    if any(ch in path for ch in "*?["):
+        return sorted(_glob.glob(path))
+    return [path] if os.path.exists(path) else []

@@ -1,0 +1,160 @@
+"""Parquet connector.
+
+Replaces the reference's ParquetScanExec (crates/engine/src/operators/parquet_scan.rs:
+40-85 — deprecated reader API, 1024-row batches through an mpsc channel). TPU
+design: decode host-side via pyarrow's C++ Parquet reader with column projection
+AND row-group pruning from pushed-down predicates (min/max statistics), then one
+`device_put` of whole columns into HBM (exec/batch.from_arrow).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import glob as _glob
+import os
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from igloo_tpu.errors import ConnectorError
+from igloo_tpu.exec.batch import schema_from_arrow
+from igloo_tpu.plan import expr as E
+from igloo_tpu.types import Schema
+
+
+class ParquetTable:
+    """One file, a directory of files, or a glob pattern."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._files = _expand(path)
+        if not self._files:
+            raise ConnectorError(f"no parquet files at {path}")
+        try:
+            self._arrow_schema = pq.read_schema(self._files[0])
+        except Exception as ex:  # corrupt/fake file (reference gap G8)
+            raise ConnectorError(f"cannot read parquet schema from "
+                                 f"{self._files[0]}: {ex}") from None
+        self._schema = schema_from_arrow(self._arrow_schema)
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return len(self._files)
+
+    def read(self, projection: Optional[list[str]] = None,
+             filters: Optional[list] = None) -> pa.Table:
+        tables = [self._read_file(f, projection, filters) for f in self._files]
+        return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+    def read_partition(self, index: int, projection=None, filters=None) -> pa.Table:
+        return self._read_file(self._files[index], projection, filters)
+
+    def _read_file(self, path: str, projection, filters) -> pa.Table:
+        try:
+            pf = pq.ParquetFile(path)
+            groups = _prune_row_groups(pf, filters)
+            if groups is None:
+                t = pf.read(columns=projection)
+            else:
+                t = pf.read_row_groups(groups, columns=projection)
+            return t
+        except ConnectorError:
+            raise
+        except Exception as ex:
+            raise ConnectorError(f"parquet read failed for {path}: {ex}") from None
+
+
+def _expand(path: str) -> list[str]:
+    if os.path.isdir(path):
+        return sorted(_glob.glob(os.path.join(path, "**", "*.parquet"),
+                                 recursive=True))
+    if any(ch in path for ch in "*?["):
+        return sorted(_glob.glob(path))
+    return [path] if os.path.exists(path) else []
+
+
+def _prune_row_groups(pf: pq.ParquetFile, filters) -> Optional[list[int]]:
+    """Row-group pruning from column statistics for simple `col <op> literal`
+    predicates. Best-effort: returning None means read everything (the engine
+    re-applies every filter exactly)."""
+    if not filters:
+        return None
+    preds = []
+    for f in filters:
+        p = _simple_pred(f)
+        if p is not None:
+            preds.append(p)
+    if not preds:
+        return None
+    meta = pf.metadata
+    name_to_idx = {meta.schema.column(i).path: i
+                   for i in range(meta.num_columns)}
+    keep = []
+    for g in range(meta.num_row_groups):
+        rg = meta.row_group(g)
+        alive = True
+        for col, op, val in preds:
+            ci = name_to_idx.get(col)
+            if ci is None:
+                continue
+            st = rg.column(ci).statistics
+            if st is None or not st.has_min_max:
+                continue
+            mn, mx = _stat_value(st.min), _stat_value(st.max)
+            if mn is None or mx is None:
+                continue
+            try:
+                if op == ">" and mx <= val:
+                    alive = False
+                elif op == ">=" and mx < val:
+                    alive = False
+                elif op == "<" and mn >= val:
+                    alive = False
+                elif op == "<=" and mn > val:
+                    alive = False
+                elif op == "=" and (val < mn or val > mx):
+                    alive = False
+            except TypeError:
+                continue
+            if not alive:
+                break
+        if alive:
+            keep.append(g)
+    if len(keep) == meta.num_row_groups:
+        return None
+    return keep
+
+
+_OPS = {E.BinOp.GT: ">", E.BinOp.GTE: ">=", E.BinOp.LT: "<", E.BinOp.LTE: "<=",
+        E.BinOp.EQ: "="}
+_FLIP = {">": "<", ">=": "<=", "<": ">", "<=": ">=", "=": "="}
+
+
+def _simple_pred(e: E.Expr):
+    """col <op> literal (either order) -> (col_name, op, python_value)."""
+    if not isinstance(e, E.Binary) or e.op not in _OPS:
+        return None
+    l, r = e.left, e.right
+    if isinstance(l, E.Column) and isinstance(r, E.Literal):
+        col, lit, op = l, r, _OPS[e.op]
+    elif isinstance(r, E.Column) and isinstance(l, E.Literal):
+        col, lit, op = r, l, _FLIP[_OPS[e.op]]
+    else:
+        return None
+    v = lit.value
+    if v is None:
+        return None
+    if lit.literal_type is not None and lit.literal_type.id.value == "date32":
+        v = _dt.date(1970, 1, 1) + _dt.timedelta(days=int(v))
+    return (col.name.split(".")[-1], op, v)
+
+
+def _stat_value(v):
+    if isinstance(v, bytes):
+        try:
+            return v.decode("utf-8")
+        except UnicodeDecodeError:
+            return None
+    return v

@@ -1,0 +1,167 @@
+"""Group-by / aggregate kernel.
+
+The reference has NO aggregation in its custom engine (DataFusion handles it on the
+working path; the custom physical planner lowers only scan/filter/project/join,
+physical_planner.rs:23-140). This is the TPU design from SURVEY.md §7 step 4:
+sort-based segment reduction — one fused XLA computation, static shapes:
+
+    keys -> lexicographic stable argsort -> contiguous groups -> boundary flags
+         -> segment_sum/min/max over static segment count (= capacity)
+
+Output capacity equals input capacity; row `i` of the output is group `i`
+(compacted to the front, `live` marks real groups). No hashing: grouping equality
+is exact lane comparison after the sort, so no collision handling is needed.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from igloo_tpu import types as T
+from igloo_tpu.exec import kernels as K
+from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn, DictInfo
+from igloo_tpu.exec.expr_compile import Compiled, Env
+from igloo_tpu.plan.expr import AggFunc
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    func: AggFunc
+    arg: Optional[Compiled]       # None only for COUNT_STAR
+    out_dtype: T.DataType
+    out_dict: Optional[DictInfo]  # MIN/MAX over strings keep the arg dictionary
+
+
+def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
+                    aggs: list[AggSpec], out_schema: T.Schema) -> DeviceBatch:
+    """Pure, jit-traceable: DeviceBatch -> DeviceBatch of one row per group."""
+    env = Env.from_batch(batch)
+    cap = batch.capacity
+    live = batch.live
+
+    # evaluate group keys once
+    gvals: list[jax.Array] = []
+    gnulls: list[Optional[jax.Array]] = []
+    for g in groups:
+        v, nl = g.fn(env)
+        gvals.append(v)
+        gnulls.append(nl)
+
+    if groups:
+        # equality lanes (string ids are already ranks; floats decompose into
+        # nan-flag + normalized-value lanes — no 64-bit bitcasts, TPU-safe)
+        flat_lanes: list = []
+        flat_nulls: list = []
+        sort_lanes: list = []
+        for v, nl, g in zip(gvals, gnulls, groups):
+            for lane in K.group_lanes_for(v, g.dtype.is_float):
+                flat_lanes.append(lane)
+                flat_nulls.append(nl)
+            sort_lanes.extend(K.sort_lanes_for(v, nl, g.dtype.is_float, True, False))
+        perm = K.lex_argsort(sort_lanes, live)
+        s_live = jnp.take(live, perm)
+        s_lanes = [jnp.take(l, perm) for l in flat_lanes]
+        s_nulls = [jnp.take(nl, perm) if nl is not None else None
+                   for nl in flat_nulls]
+        seg, start = K.group_segments(s_lanes, s_nulls, s_live)
+        num_groups = jnp.sum(start.astype(jnp.int32))
+    else:
+        # global aggregate: one group holding every live row; emit exactly one
+        # output row even over empty input (SQL: COUNT=0, SUM=NULL)
+        perm = jnp.arange(cap, dtype=jnp.int32)
+        s_live = live
+        seg = jnp.zeros((cap,), dtype=jnp.int32)
+        start = jnp.zeros((cap,), dtype=bool).at[0].set(True)
+        num_groups = jnp.int32(1)
+
+    # first sorted row of each segment (for group representative values)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    big = jnp.int32(cap)
+    first_pos = jax.ops.segment_min(jnp.where(s_live, pos, big), seg,
+                                    num_segments=cap)
+    first_pos = jnp.clip(first_pos, 0, cap - 1)
+
+    out_cols: list[DeviceColumn] = []
+    # group key output columns
+    for v, nl, g in zip(gvals, gnulls, groups):
+        sv = jnp.take(jnp.take(v, perm), first_pos)
+        snl = jnp.take(jnp.take(nl, perm), first_pos) if nl is not None else None
+        out_cols.append(DeviceColumn(g.dtype, sv.astype(g.dtype.device_dtype())
+                                     if sv.dtype != g.dtype.device_dtype() else sv,
+                                     snl, g.out_dict))
+
+    # aggregates via segment reductions over sorted order
+    for spec in aggs:
+        out_cols.append(_reduce_one(spec, env, perm, seg, s_live, cap))
+
+    out_live = jnp.arange(cap, dtype=jnp.int32) < num_groups
+    return DeviceBatch(out_schema, out_cols, out_live)
+
+
+def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap) -> DeviceColumn:
+    if spec.func is AggFunc.COUNT_STAR:
+        cnt = jax.ops.segment_sum(s_live.astype(jnp.int64), seg, num_segments=cap)
+        return DeviceColumn(T.INT64, cnt, None, None)
+
+    v, nl = spec.arg.fn(env)
+    sv = jnp.take(v, perm)
+    snl = jnp.take(nl, perm) if nl is not None else None
+    valid = s_live if snl is None else (s_live & ~snl)
+    n_valid = jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=cap)
+    all_null = n_valid == 0
+
+    if spec.func is AggFunc.COUNT:
+        return DeviceColumn(T.INT64, n_valid, None, None)
+
+    if spec.func is AggFunc.SUM or spec.func is AggFunc.AVG:
+        acc_dtype = jnp.float64 if (spec.out_dtype.is_float or
+                                    spec.func is AggFunc.AVG) else jnp.int64
+        sval = jnp.where(valid, sv.astype(acc_dtype), jnp.zeros((), acc_dtype))
+        total = jax.ops.segment_sum(sval, seg, num_segments=cap)
+        if spec.func is AggFunc.AVG:
+            denom = jnp.where(all_null, 1, n_valid).astype(jnp.float64)
+            return DeviceColumn(T.FLOAT64, total / denom, all_null, None)
+        return DeviceColumn(spec.out_dtype,
+                            total.astype(spec.out_dtype.device_dtype()),
+                            all_null, None)
+
+    # MIN / MAX: sentinel-masked segment reduce on a comparable lane, then an
+    # exact gather of the original value at a winning position (so e.g. a NaN
+    # winner comes back as NaN, not as its +inf ordering surrogate)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    if spec.arg.dtype.is_float:
+        vnorm, nan = K.normalize_float(sv)
+        lane = jnp.where(nan, jnp.asarray(jnp.inf, vnorm.dtype), vnorm)
+        lo = jnp.asarray(-jnp.inf, lane.dtype)
+        hi = jnp.asarray(jnp.inf, lane.dtype)
+    else:
+        lane = sv.astype(jnp.int64)
+        lo = jnp.iinfo(jnp.int64).min
+        hi = jnp.iinfo(jnp.int64).max
+    if spec.func is AggFunc.MIN:
+        keyed = jnp.where(valid, lane, hi)
+        best_lane = jax.ops.segment_min(keyed, seg, num_segments=cap)
+    else:
+        keyed = jnp.where(valid, lane, lo)
+        best_lane = jax.ops.segment_max(keyed, seg, num_segments=cap)
+    # recover a row index holding the winning lane value for exact value gather
+    is_best = valid & (keyed == jnp.take(best_lane, seg))
+    best_pos = jax.ops.segment_min(jnp.where(is_best, pos, jnp.int32(cap)), seg,
+                                   num_segments=cap)
+    best_pos = jnp.clip(best_pos, 0, cap - 1)
+    out_val = jnp.take(sv, best_pos)
+    return DeviceColumn(spec.out_dtype, out_val, all_null, spec.out_dict)
+
+
+def distinct_batch(batch: DeviceBatch) -> DeviceBatch:
+    """SELECT DISTINCT: group by every column, no aggregates."""
+    groups = []
+    for i, (f, c) in enumerate(zip(batch.schema, batch.columns)):
+        comp = Compiled(lambda env, _i=i: (env.values[_i], env.nulls[_i]),
+                        f.dtype, c.dictionary)
+        groups.append(comp)
+    return aggregate_batch(batch, groups, [], batch.schema)

@@ -111,6 +111,27 @@ class DictInfo:
     def __len__(self) -> int:
         return len(self.values)
 
+    # DictInfo rides in jit static aux data (pytree aux of DeviceColumn): hash/eq
+    # by content fingerprint so identical dictionaries share compile-cache entries.
+    def _fingerprint(self) -> int:
+        fp = getattr(self, "_fp", None)
+        if fp is None:
+            fp = hash((len(self.values), self.hashes.tobytes()))
+            object.__setattr__(self, "_fp", fp)
+        return fp
+
+    def __hash__(self) -> int:
+        return self._fingerprint()
+
+    def __eq__(self, other) -> bool:
+        # exact content equality (only reached after a fingerprint bucket match,
+        # so the array compare is rare): a fingerprint collision must NOT alias
+        # two dictionaries in the jit compile cache
+        return isinstance(other, DictInfo) and \
+            self._fingerprint() == other._fingerprint() and \
+            np.array_equal(self.hashes, other.hashes) and \
+            np.array_equal(self.hashes2, other.hashes2)
+
 
 @dataclass
 class DeviceColumn:
@@ -164,6 +185,23 @@ class DeviceBatch:
             cols.append(DeviceColumn(f.dtype, vals, None,
                                      DictInfo.from_values([]) if f.dtype.is_string else None))
         return DeviceBatch(schema, cols, jnp.zeros((capacity,), dtype=bool))
+
+
+# --- pytree registration: DeviceBatch/DeviceColumn flow straight through jax.jit
+# (arrays are leaves; dtype/schema/dictionaries are static aux so the compile
+# cache keys on them — shape bucketing + dictionary fingerprints keep it small)
+
+jax.tree_util.register_pytree_node(
+    DeviceColumn,
+    lambda c: ((c.values, c.nulls), (c.dtype, c.dictionary)),
+    lambda aux, ch: DeviceColumn(aux[0], ch[0], ch[1], aux[1]),
+)
+
+jax.tree_util.register_pytree_node(
+    DeviceBatch,
+    lambda b: ((b.columns, b.live), b.schema),
+    lambda aux, ch: DeviceBatch(aux, ch[0], ch[1]),
+)
 
 
 # ---------------------------------------------------------------------------

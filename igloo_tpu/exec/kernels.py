@@ -1,0 +1,214 @@
+"""Shared device kernel primitives.
+
+These are the building blocks the reference implements as per-row Rust loops
+(hash_join.rs:116-211 row-at-a-time build/probe, filter.rs:47-57 per-batch eval) —
+re-designed as static-shape, whole-column XLA programs:
+
+- key normalization: any column -> int64 "key lane(s)" whose ordering/equality
+  matches SQL semantics (floats via order-preserving bit tricks, strings via
+  sorted-dictionary ids or dictionary hash lanes for cross-table equality)
+- lexicographic argsort via iterated stable sorts (the TPU-friendly way to sort
+  multi-key rows: no comparators, just k stable sorts of an index permutation)
+- group boundary detection + segment ids for segment-reduce aggregation
+- selection-mask compaction (stable partition live-to-front) — the static-shape
+  replacement for the reference's eager `filter_record_batch`
+- 64-bit avalanche hashing for multi-lane join keys (verified exactly afterwards,
+  so collisions cost slots, never correctness)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from igloo_tpu import types as T
+from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn, DictInfo
+
+# splitmix64 constants (public-domain finalizer)
+_C1 = np.int64(np.uint64(0xBF58476D1CE4E5B9).astype(np.int64))
+_C2 = np.int64(np.uint64(0x94D049BB133111EB).astype(np.int64))
+_GOLDEN = np.int64(np.uint64(0x9E3779B97F4A7C15).astype(np.int64))
+
+
+def mix64(x: jax.Array) -> jax.Array:
+    """splitmix64 avalanche over an int64 lane."""
+    x = x.astype(jnp.int64)
+    ux = x.astype(jnp.uint64)
+    ux = ux ^ (ux >> np.uint64(30))
+    ux = ux * np.uint64(0xBF58476D1CE4E5B9)
+    ux = ux ^ (ux >> np.uint64(27))
+    ux = ux * np.uint64(0x94D049BB133111EB)
+    ux = ux ^ (ux >> np.uint64(31))
+    return ux.astype(jnp.int64)
+
+
+def hash_lanes(lanes: list[jax.Array], nulls: list[Optional[jax.Array]]) -> jax.Array:
+    """Combine key lanes into one well-mixed int64 per row. NULL contributes a
+    distinct tag so (1, NULL) != (1, 2) pre-verification."""
+    h = jnp.full(lanes[0].shape, _GOLDEN, dtype=jnp.int64)
+    for lane, nl in zip(lanes, nulls):
+        v = lane.astype(jnp.int64)
+        if nl is not None:
+            v = jnp.where(nl, np.int64(-0x61C8864680B583EB), v)
+        h = mix64(h ^ mix64(v))
+    return h
+
+
+def normalize_float(x: jax.Array):
+    """Canonicalize a float lane for grouping/hashing WITHOUT 64-bit bitcasts
+    (the TPU X64 rewriter does not implement f64<->s64 bitcast-convert): returns
+    (vnorm, nan_flag) where -0.0 -> +0.0 and every NaN collapses to 0.0 with the
+    flag set. Equality on (vnorm, nan_flag) == SQL grouping equality; ordering on
+    them (NaN flag as a more significant lane) == SQL "NaN sorts greatest"."""
+    xf = x
+    xf = jnp.where(xf == 0.0, jnp.zeros((), xf.dtype), xf)
+    nan = jnp.isnan(xf)
+    return jnp.where(nan, jnp.zeros((), xf.dtype), xf), nan
+
+
+def float_hash_int_lanes(x: jax.Array) -> list[jax.Array]:
+    """Deterministic int64 lanes for hashing a float lane, bitcast-free: integer
+    part + scaled fraction + nan flag. Equal floats always map to equal lanes
+    (required); nearby floats may collide (harmless — joins verify exactly)."""
+    vnorm, nan = normalize_float(x)
+    v = vnorm.astype(jnp.float64)
+    # clamp so .astype(int64) is defined, keep determinism
+    bounded = jnp.clip(v, -9.0e15, 9.0e15)
+    ipart = bounded.astype(jnp.int64)
+    frac = (bounded - ipart.astype(jnp.float64)) * np.float64(2.0 ** 52)
+    return [ipart, frac.astype(jnp.int64), nan.astype(jnp.int64)]
+
+
+def sort_lanes_for(v: jax.Array, null: Optional[jax.Array], is_float: bool,
+                   ascending: bool, nulls_first: bool) -> list[tuple]:
+    """Decompose one sort key into [(lane, ascending_flag), ...] most-significant
+    first: null ordering lane, NaN lane (floats; NaN sorts greatest), value lane.
+    Works for any lane dtype jnp.argsort accepts — no int64 bit tricks."""
+    lanes: list[tuple] = []
+    if null is None:
+        nkey = jnp.zeros(v.shape, dtype=jnp.int32)
+    else:
+        nkey = jnp.where(null, np.int32(-1 if nulls_first else 1), np.int32(0))
+    lanes.append((nkey, True))
+    if is_float:
+        vnorm, nan = normalize_float(v)
+        lanes.append((nan.astype(jnp.int32), ascending))  # NaN greatest
+        val = vnorm
+    else:
+        val = v
+    if null is not None:
+        val = jnp.where(null, jnp.zeros((), val.dtype), val)
+    lanes.append((val, ascending))
+    return lanes
+
+
+def group_lanes_for(v: jax.Array, is_float: bool) -> list[jax.Array]:
+    """Equality lanes for grouping: floats become (nan_flag, vnorm)."""
+    if is_float:
+        vnorm, nan = normalize_float(v)
+        return [nan.astype(jnp.int32), vnorm]
+    return [v]
+
+
+def _argsort_dir(lane: jax.Array, ascending: bool) -> jax.Array:
+    if ascending:
+        return jnp.argsort(lane, stable=True)
+    if lane.dtype == jnp.bool_:
+        lane = lane.astype(jnp.int32)
+    return jnp.argsort(-lane, stable=True)
+
+
+def lex_argsort(lanes: list, live: jax.Array) -> jax.Array:
+    """Stable lexicographic argsort. `lanes` = [(lane, ascending), ...]
+    most-significant first. Dead rows always sort last. Returns permutation."""
+    n = live.shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    # iterated stable sorts from least-significant lane to most-significant
+    for lane, asc in reversed(lanes):
+        perm = perm[_argsort_dir(jnp.take(lane, perm), asc)]
+    # dead rows last (most significant)
+    perm = perm[jnp.argsort(jnp.take(~live, perm), stable=True)]
+    return perm
+
+
+def group_segments(sorted_lanes: list, sorted_nulls: list,
+                   sorted_live: jax.Array):
+    """Given key lanes already permuted into sorted order, return
+    (segment_id per row int32, is_group_start bool). Dead rows get segment id
+    pointing at a trailing dummy segment."""
+    n = sorted_live.shape[0]
+    differs = jnp.zeros((n - 1,), dtype=bool) if n > 1 else jnp.zeros((0,), dtype=bool)
+    for lane, nl in zip(sorted_lanes, sorted_nulls):
+        dval = lane[1:] != lane[:-1]
+        if nl is not None:
+            n1, n0 = nl[1:], nl[:-1]
+            # adjacent rows differ unless both NULL or both equal non-NULL
+            # (SQL GROUP BY treats NULLs as one group)
+            d = (n1 != n0) | (~n1 & ~n0 & dval)
+        else:
+            d = dval
+        differs = differs | d
+    first = jnp.ones((1,), dtype=bool) if n > 0 else jnp.zeros((0,), dtype=bool)
+    boundary = jnp.concatenate([first, differs | (sorted_live[1:] != sorted_live[:-1])]) \
+        if n > 1 else first
+    start = boundary & sorted_live
+    seg = jnp.cumsum(start.astype(jnp.int32)) - 1
+    seg = jnp.where(sorted_live & (seg >= 0), seg, max(n - 1, 0))
+    return seg.astype(jnp.int32), start
+
+
+def compact_perm(live: jax.Array) -> jax.Array:
+    """Stable permutation bringing live rows to the front."""
+    return jnp.argsort(~live, stable=True)
+
+
+def apply_perm(batch: DeviceBatch, perm: jax.Array) -> DeviceBatch:
+    cols = []
+    for c in batch.columns:
+        vals = jnp.take(c.values, perm)
+        nulls = jnp.take(c.nulls, perm) if c.nulls is not None else None
+        cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
+    return DeviceBatch(batch.schema, cols, jnp.take(batch.live, perm))
+
+
+def gather_batch(batch: DeviceBatch, idx: jax.Array,
+                 valid: Optional[jax.Array] = None,
+                 null_pad: bool = False) -> list[DeviceColumn]:
+    """Gather rows of all columns by `idx`. When `null_pad` and valid is given,
+    out-of-match rows become NULL (outer-join padding)."""
+    cols = []
+    safe = jnp.clip(idx, 0, batch.capacity - 1)
+    for c in batch.columns:
+        vals = jnp.take(c.values, safe)
+        nulls = jnp.take(c.nulls, safe) if c.nulls is not None else None
+        if null_pad and valid is not None:
+            pad = ~valid
+            nulls = pad if nulls is None else (nulls | pad)
+        cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
+    return cols
+
+
+def resize_to(values: jax.Array, capacity: int, fill=0) -> jax.Array:
+    n = values.shape[0]
+    if n == capacity:
+        return values
+    if n > capacity:
+        return values[:capacity]
+    pad = jnp.full((capacity - n,), fill, dtype=values.dtype)
+    return jnp.concatenate([values, pad])
+
+
+def resize_batch(batch: DeviceBatch, capacity: int) -> DeviceBatch:
+    """Change a batch's static capacity (host-decided; used for shape bucketing
+    after host-synced row counts). Live rows must already be compacted when
+    shrinking."""
+    if capacity == batch.capacity:
+        return batch
+    cols = []
+    for c in batch.columns:
+        vals = resize_to(c.values, capacity)
+        nulls = resize_to(c.nulls, capacity, fill=False) if c.nulls is not None else None
+        cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
+    return DeviceBatch(batch.schema, cols, resize_to(batch.live, capacity, fill=False))

@@ -1,0 +1,35 @@
+"""Sort / Limit / Offset kernels.
+
+The reference delegates ORDER BY/LIMIT to DataFusion entirely (no custom operator).
+TPU design: multi-key sort = k iterated stable argsorts over order-normalized int64
+lanes (kernels.lex_argsort) — no comparators, fully static shapes. LIMIT is a mask
+over the running live-row count, not a truncation, so shapes stay put.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from igloo_tpu.exec import kernels as K
+from igloo_tpu.exec.batch import DeviceBatch
+from igloo_tpu.exec.expr_compile import Compiled, Env
+
+
+def sort_batch(batch: DeviceBatch, keys: list[Compiled], ascending: list[bool],
+               nulls_first: list[bool]) -> DeviceBatch:
+    """Jit-traceable stable sort; dead rows end up last."""
+    env = Env.from_batch(batch)
+    lanes = []
+    for k, asc, nf in zip(keys, ascending, nulls_first):
+        v, nl = k.fn(env)
+        lanes.extend(K.sort_lanes_for(v, nl, k.dtype.is_float, asc, nf))
+    perm = K.lex_argsort(lanes, batch.live)
+    return K.apply_perm(batch, perm)
+
+
+def limit_batch(batch: DeviceBatch, limit, offset: int = 0) -> DeviceBatch:
+    """Jit-traceable: keep live rows (offset, offset+limit] in current row order."""
+    cum = jnp.cumsum(batch.live.astype(jnp.int64))
+    keep = batch.live & (cum > offset)
+    if limit is not None:
+        keep = keep & (cum <= offset + limit)
+    return DeviceBatch(batch.schema, batch.columns, keep)

@@ -85,12 +85,15 @@ class Scope:
 @dataclass
 class OuterRef(E.Expr):
     """Placeholder for a correlated reference to an outer-query column; replaced
-    during decorrelation (never reaches the executor)."""
+    during decorrelation (never reaches the executor). `level` counts scopes
+    outward (1 = immediate parent); only level-1 correlation can be rewritten
+    into a join against the parent plan."""
     name: str = ""
     entry: ScopeEntry = None  # type: ignore[assignment]
+    level: int = 1
 
     def __repr__(self):
-        return f"outer({self.name})"
+        return f"outer({self.name}@{self.level})"
 
 
 # --- aggregate typing ------------------------------------------------------------
@@ -274,13 +277,15 @@ class Binder:
             plan = d
 
         plan = self._apply_order_limit(plan, stmt, out_scope,
-                                       None if stmt.distinct else proj_node)
+                                       None if stmt.distinct else proj_node,
+                                       hidden_scope=scope)
         return plan
 
     # --- ORDER BY / LIMIT ---
 
     def _apply_order_limit(self, plan, stmt: A.SelectStmt, out_scope: Scope,
-                           proj_node: Optional[L.Project]) -> L.LogicalPlan:
+                           proj_node: Optional[L.Project],
+                           hidden_scope: Optional[Scope] = None) -> L.LogicalPlan:
         if stmt.order_by:
             keys, asc, nf = [], [], []
             hidden: list[E.Expr] = []
@@ -294,8 +299,11 @@ class Binder:
                     if proj_node is None:
                         raise PlanError(
                             f"ORDER BY expression {ex!r} not in output columns")
-                    # hidden sort column: bind against projection input, append
-                    in_scope = Scope.from_schema(proj_node.input.schema)
+                    # hidden sort column: bind against the projection's input
+                    # scope (qualified FROM scope pre-aggregation, aggregate
+                    # output scope post-aggregation), append to the projection
+                    in_scope = hidden_scope if hidden_scope is not None \
+                        else Scope.from_schema(proj_node.input.schema)
                     hb = self.bind_expr(ex, in_scope, proj_node.input)
                     hname = f"__sort_{len(hidden)}"
                     hidden.append(hb)
@@ -384,7 +392,9 @@ class Binder:
             name = ref.name
             key = name.split(".")[-1].lower()
             if key in self._cte_env:
-                plan = self._cte_env[key]
+                # fresh copy per reference: the optimizer rewrites plans in
+                # place, so two FROM positions must not share one subtree
+                plan = L.copy_plan(self._cte_env[key])
                 alias = ref.alias or key
                 return plan, Scope.from_schema(plan.schema, alias)
             provider = self.catalog.get(name)
@@ -441,11 +451,16 @@ class Binder:
         jt = ref.join_type
 
         using = ref.using
-        if using is not None and len(using) == 0:  # NATURAL
+        natural = using is not None and len(using) == 0
+        if natural:
             lnames = {e.name.lower() for e in lscope.entries}
             using = [e.name for e in rscope.entries if e.name.lower() in lnames]
             if not using:
-                jt = A.JoinType.CROSS
+                # no shared columns: INNER degenerates to CROSS; outer NATURAL
+                # joins keep their type (empty keys = all pairs match, with
+                # null-extension when a side is empty)
+                if jt is A.JoinType.INNER:
+                    jt = A.JoinType.CROSS
                 using = None
 
         left_keys: list[E.Expr] = []
@@ -485,7 +500,7 @@ class Binder:
                 else:
                     residual_parts.append(c)
             residual = _and_all(residual_parts)
-        elif jt is not A.JoinType.CROSS:
+        elif jt is not A.JoinType.CROSS and not natural:
             raise PlanError("JOIN requires ON or USING")
 
         node = L.Join(left=lplan, right=rplan, join_type=jt,
@@ -504,7 +519,7 @@ class Binder:
             out_scope = Scope(list(lscope.entries) + [
                 ScopeEntry(e.qualifier, e.name, e.dtype,
                            len(lplan.schema) + i) for i, e in enumerate(rentries)])
-            node = self._project_using(node, lplan, rplan, drop)
+            node = self._project_using(node, lplan, rplan, drop, jt)
         else:
             node.schema = T.Schema(_dedup_fields(
                 list(lplan.schema) + list(rplan.schema)))
@@ -512,17 +527,30 @@ class Binder:
         out_scope.parent = outer
         return node, out_scope
 
-    def _project_using(self, join: L.Join, lplan, rplan, drop: set) -> L.LogicalPlan:
-        """Narrow a USING join's raw (left++right) output to drop the right-side
-        duplicate key columns, keeping scope indices consistent."""
+    def _project_using(self, join: L.Join, lplan, rplan, drop: set,
+                       jt: A.JoinType) -> L.LogicalPlan:
+        """Narrow a USING join's raw (left++right) output to a single copy of
+        each shared key column. For RIGHT/FULL joins the key must be
+        COALESCE(left, right): unmatched right rows carry the right value."""
+        coalesce_key = jt in (A.JoinType.RIGHT, A.JoinType.FULL)
+        n_left = len(lplan.schema)
         exprs, names = [], []
         full = list(lplan.schema) + list(rplan.schema)
         for i, f in enumerate(full):
-            if i >= len(lplan.schema) and f.name.lower() in drop:
+            if i >= n_left and f.name.lower() in drop:
                 continue
             c = E.Column(f.name, index=i)
             c.dtype = f.dtype
-            exprs.append(c)
+            ex: E.Expr = c
+            if i < n_left and f.name.lower() in drop and coalesce_key:
+                rj = next(j for j, rf in enumerate(rplan.schema)
+                          if rf.name.lower() == f.name.lower())
+                rc = E.Column(f.name, index=n_left + rj)
+                rc.dtype = rplan.schema.fields[rj].dtype
+                fn = E.Func(name="coalesce", args=[c, rc])
+                fn.dtype = T.common_type(c.dtype, rc.dtype)
+                ex = fn
+            exprs.append(ex)
             names.append(f.name)
         raw_schema = T.Schema(_dedup_fields(full))
         join.schema = raw_schema
@@ -563,9 +591,30 @@ class Binder:
         sub, corr_l, corr_r = self._decorrelate(sub, plan.schema)
         key_r = E.Column(sub.schema.fields[0].name, index=0)
         key_r.dtype = sub.schema.fields[0].dtype
-        j = L.Join(left=plan, right=sub,
-                   join_type=A.JoinType.ANTI if anti else A.JoinType.SEMI,
-                   left_keys=[probe] + corr_l, right_keys=[key_r] + corr_r)
+        if not anti:
+            j = L.Join(left=plan, right=sub, join_type=A.JoinType.SEMI,
+                       left_keys=[probe] + corr_l, right_keys=[key_r] + corr_r)
+            j.schema = plan.schema
+            return j
+        # NOT IN: anti join on the CORRELATION keys only, with the IN condition
+        # as a residual that is satisfied when the pair is "not definitely
+        # unequal": probe = y OR y IS NULL OR probe IS NULL. This encodes SQL
+        # three-valued NOT IN exactly, per correlation group:
+        #   empty group            -> no candidate -> row kept
+        #   group contains NULL y  -> residual true -> row dropped
+        #   probe NULL, group != {} -> residual true -> row dropped
+        n_left = len(plan.schema)
+        key_r_comb = E.Column(sub.schema.fields[0].name, index=n_left)
+        key_r_comb.dtype = key_r.dtype
+        eq = E.Binary(op=E.BinOp.EQ, left=copy.deepcopy(probe), right=key_r_comb)
+        eq.dtype = T.BOOL
+        y_null = E.IsNull(operand=copy.deepcopy(key_r_comb))
+        y_null.dtype = T.BOOL
+        x_null = E.IsNull(operand=copy.deepcopy(probe))
+        x_null.dtype = T.BOOL
+        residual = _or_all([eq, y_null, x_null])
+        j = L.Join(left=plan, right=sub, join_type=A.JoinType.ANTI,
+                   left_keys=corr_l, right_keys=corr_r, residual=residual)
         j.schema = plan.schema
         return j
 
@@ -595,8 +644,10 @@ class Binder:
     def _decorrelate(self, sub: L.LogicalPlan, outer_schema):
         """Pull correlated equality predicates (OuterRef = inner_col) out of the
         subquery plan, returning (rewritten_sub, outer_keys, inner_key_cols).
-        Inner key columns are appended to the subquery output if not projected."""
-        corr: list[tuple[ScopeEntry, E.Expr]] = []
+        Inner key columns are appended to the subquery output; each stripped
+        predicate remembers the schema its inner side was bound against so the
+        keys are attached at a projection with a MATCHING input schema."""
+        corr: list[tuple[ScopeEntry, E.Expr, T.Schema]] = []
 
         def strip(plan: L.LogicalPlan) -> L.LogicalPlan:
             if isinstance(plan, L.Filter):
@@ -604,7 +655,7 @@ class Binder:
                 for c in _split_conjuncts(plan.predicate):
                     pair = _extract_corr_eq(c)
                     if pair is not None:
-                        corr.append(pair)
+                        corr.append((pair[0], pair[1], plan.input.schema))
                     else:
                         if any(isinstance(n, OuterRef) for n in E.walk(c)):
                             raise NotSupportedError(
@@ -630,25 +681,42 @@ class Binder:
             raise NotSupportedError("correlated reference outside WHERE equality")
         outer_keys, inner_cols = [], []
         if corr:
-            # append inner key columns to the subquery output via projection
-            exprs, names = [], []
-            for i, f in enumerate(sub.schema):
-                c = E.Column(f.name, index=i)
-                c.dtype = f.dtype
-                exprs.append(c)
-                names.append(f.name)
-            base_n = len(exprs)
-            for k, (outer_entry, inner_expr) in enumerate(corr):
+            for outer_entry, _, _ in corr:
                 oc = E.Column(outer_entry.name, index=outer_entry.index)
                 oc.dtype = outer_entry.dtype
                 outer_keys.append(oc)
-                exprs.append(inner_expr)
-                names.append(f"__corr_{k}")
-            pr = L.Project(input=sub, exprs=exprs, names=names)
-            pr.schema = T.Schema([T.Field(n, ex.dtype, True)
-                                  for n, ex in zip(names, exprs)])
-            sub = pr
-            for k, (_, inner_expr) in enumerate(corr):
+            if isinstance(sub, L.Project) and all(
+                    sc == sub.input.schema for _, _, sc in corr):
+                # extend the subquery's own projection: the stripped predicates
+                # were bound against exactly its input schema
+                base_n = len(sub.exprs)
+                for k, (_, inner_expr, _) in enumerate(corr):
+                    sub.exprs.append(inner_expr)
+                    sub.names.append(f"__corr_{k}")
+                sub.schema = T.Schema(list(sub.schema.fields) + [
+                    T.Field(f"__corr_{k}", ie.dtype, True)
+                    for k, (_, ie, _) in enumerate(corr)])
+            elif all(sc == sub.schema for _, _, sc in corr):
+                # keys bound against the subquery output itself: wrap once
+                exprs, names = [], []
+                for i, f in enumerate(sub.schema):
+                    c = E.Column(f.name, index=i)
+                    c.dtype = f.dtype
+                    exprs.append(c)
+                    names.append(f.name)
+                base_n = len(exprs)
+                for k, (_, inner_expr, _) in enumerate(corr):
+                    exprs.append(inner_expr)
+                    names.append(f"__corr_{k}")
+                pr = L.Project(input=sub, exprs=exprs, names=names)
+                pr.schema = T.Schema([T.Field(n, ex.dtype, True)
+                                      for n, ex in zip(names, exprs)])
+                sub = pr
+            else:
+                raise NotSupportedError(
+                    "correlated predicate below a schema-changing operator "
+                    "(aggregate/join) is not supported yet")
+            for k, (_, inner_expr, _) in enumerate(corr):
                 ic = E.Column(f"__corr_{k}", index=base_n + k)
                 ic.dtype = inner_expr.dtype
                 inner_cols.append(ic)
@@ -749,7 +817,7 @@ class Binder:
             if ent is None:
                 raise PlanError(f"column not found: {e.name}")
             if lvl > 0:
-                o = OuterRef(name=e.name, entry=ent)
+                o = OuterRef(name=e.name, entry=ent, level=lvl)
                 o.dtype = ent.dtype
                 return o
             c = E.Column(e.name, index=ent.index)
@@ -980,6 +1048,15 @@ def _and_all(parts: list[E.Expr]) -> Optional[E.Expr]:
     return out
 
 
+def _or_all(parts: list[E.Expr]) -> E.Expr:
+    out = parts[0]
+    for p in parts[1:]:
+        n = E.Binary(op=E.BinOp.OR, left=out, right=p)
+        n.dtype = T.BOOL
+        out = n
+    return out
+
+
 def _extract_equi_key(c: E.Expr, n_left: int):
     """If conjunct is `expr_L = expr_R` with sides fully on left/right of a join
     (column indices < n_left vs >= n_left), return (left_key, right_key with
@@ -1014,15 +1091,17 @@ def _extract_equi_key(c: E.Expr, n_left: int):
 
 
 def _extract_corr_eq(c: E.Expr):
-    """If conjunct is OuterRef = inner_expr (either order), return
-    (outer_entry, inner_expr); else None."""
+    """If conjunct is level-1 OuterRef = inner_expr (either order), return
+    (outer_entry, inner_expr); else None. Deeper-nested references (level > 1)
+    cannot be decorrelated against the immediate parent and must be rejected by
+    the caller's has-outer check."""
     if not (isinstance(c, E.Binary) and c.op is E.BinOp.EQ):
         return None
     l, r = c.left, c.right
-    if isinstance(l, OuterRef) and not any(
+    if isinstance(l, OuterRef) and l.level == 1 and not any(
             isinstance(n, OuterRef) for n in E.walk(r)):
         return (l.entry, r)
-    if isinstance(r, OuterRef) and not any(
+    if isinstance(r, OuterRef) and r.level == 1 and not any(
             isinstance(n, OuterRef) for n in E.walk(l)):
         return (r.entry, l)
     return None

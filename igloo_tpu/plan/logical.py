@@ -169,6 +169,51 @@ class Values(LogicalPlan):
     rows: list[list[object]] = field(default_factory=list)  # python values
 
 
+def copy_plan(plan: LogicalPlan) -> LogicalPlan:
+    """Structural copy of a plan tree: nodes and expressions are fresh objects
+    (safe for in-place optimizer rewrites), table providers are shared. Needed
+    when one subtree is referenced twice (CTE used in two FROM positions)."""
+    import copy as _copy
+    n = _copy.copy(plan)
+    if isinstance(n, Scan):
+        n.pushed_filters = [_copy.deepcopy(e) for e in n.pushed_filters]
+        n.projection = list(n.projection) if n.projection is not None else None
+    elif isinstance(n, Filter):
+        n.input = copy_plan(n.input)
+        n.predicate = _copy.deepcopy(n.predicate)
+    elif isinstance(n, Project):
+        n.input = copy_plan(n.input)
+        n.exprs = [_copy.deepcopy(e) for e in n.exprs]
+        n.names = list(n.names)
+    elif isinstance(n, Aggregate):
+        n.input = copy_plan(n.input)
+        n.group_exprs = [_copy.deepcopy(e) for e in n.group_exprs]
+        n.group_names = list(n.group_names)
+        n.aggs = [_copy.deepcopy(a) for a in n.aggs]
+        n.agg_names = list(n.agg_names)
+    elif isinstance(n, Join):
+        n.left = copy_plan(n.left)
+        n.right = copy_plan(n.right)
+        n.left_keys = [_copy.deepcopy(e) for e in n.left_keys]
+        n.right_keys = [_copy.deepcopy(e) for e in n.right_keys]
+        n.residual = _copy.deepcopy(n.residual) if n.residual is not None else None
+    elif isinstance(n, Sort):
+        n.input = copy_plan(n.input)
+        n.keys = [_copy.deepcopy(e) for e in n.keys]
+        n.ascending = list(n.ascending)
+        n.nulls_first = list(n.nulls_first)
+    elif isinstance(n, (Limit, Distinct)):
+        n.input = copy_plan(n.input)
+    elif isinstance(n, Union):
+        n.inputs = [copy_plan(c) for c in n.inputs]
+    elif isinstance(n, SetOpJoin):
+        n.left = copy_plan(n.left)
+        n.right = copy_plan(n.right)
+    elif isinstance(n, Values):
+        n.rows = [list(r) for r in n.rows]
+    return n
+
+
 def plan_tree_str(plan: LogicalPlan, indent: int = 0) -> str:
     lines = ["  " * indent + plan.node_name()]
     for c in plan.children():

@@ -125,11 +125,16 @@ def _fold_binary(op: E.BinOp, l: E.Literal, r: E.Literal,
         elif op is E.BinOp.DIV:
             if b == 0:
                 return _lit(None, out_dtype or T.NULL)
-            v = a // b if out_dtype is not None and out_dtype.is_integer else a / b
+            # SQL integer division TRUNCATES (matches the runtime kernel,
+            # expr_compile _compile_numeric_binary) — Python // floors
+            if out_dtype is not None and out_dtype.is_integer:
+                v = _trunc_div(a, b)
+            else:
+                v = a / b
         elif op is E.BinOp.MOD:
             if b == 0:
                 return _lit(None, out_dtype or T.NULL)
-            v = a % b
+            v = a - _trunc_div(a, b) * b  # truncating remainder, sign of a
         elif op is E.BinOp.EQ:
             return _lit(a == b, T.BOOL)
         elif op is E.BinOp.NEQ:
@@ -147,6 +152,11 @@ def _fold_binary(op: E.BinOp, l: E.Literal, r: E.Literal,
     except TypeError:
         return None
     return _lit(v, out_dtype or l.dtype)
+
+
+def _trunc_div(a, b):
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
 
 
 def _fold_cast(lit: E.Literal, to: T.DataType) -> Optional[E.Expr]:
@@ -231,7 +241,9 @@ def _pushdown(plan: L.LogicalPlan, preds: list[E.Expr]) -> L.LogicalPlan:
         sinkable, stuck = [], []
         for p in preds:
             cols = _cols_of(p)
-            if all(i < k for i in cols) and not _has_scalar_subquery(p):
+            # k == 0 (global aggregate) must keep filters above: it emits one
+            # row even over empty input, so sinking flips "no rows" to "one row"
+            if k > 0 and all(i < k for i in cols) and not _has_scalar_subquery(p):
                 def sub(n):
                     if isinstance(n, E.Column):
                         return copy.deepcopy(plan.group_exprs[n.index])
@@ -402,12 +414,13 @@ def _prune(plan: L.LogicalPlan, required: set[int]):
         plan.left_keys = [_remap_cols(k, lmap) for k in plan.left_keys]
         plan.right_keys = [_remap_cols(k, rmap) for k in plan.right_keys]
         new_n_left = len(plan.left.schema)
+        # combined mapping always covers both sides: the residual may reference
+        # right-side columns even in semi/anti joins (NOT IN rewrite)
         comb = {}
         for old, new in lmap.items():
             comb[old] = new
-        if not semi:
-            for old, new in rmap.items():
-                comb[old + n_left] = new + new_n_left
+        for old, new in rmap.items():
+            comb[old + n_left] = new + new_n_left
         if plan.residual is not None:
             plan.residual = _remap_cols(plan.residual, comb)
         if semi:

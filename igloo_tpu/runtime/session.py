@@ -1,0 +1,74 @@
+"""Python session API — the first-class pyigloo replacement.
+
+The reference's Python bindings are an empty stub (pyigloo/src/lib.rs, gap in
+SURVEY.md §2 #30). Since this framework is Python-hosted, the session IS the
+native API: `igloo_tpu.connect()` -> Session with register_* + sql(), returning
+pyarrow Tables (and pandas via .to_pandas()).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+
+from igloo_tpu.config import Config, make_provider
+from igloo_tpu.engine import QueryEngine, QueryResult
+
+
+class Session:
+    def __init__(self, config: Optional[Config | str] = None,
+                 use_jit: bool = True):
+        if isinstance(config, str):
+            config = Config.load(config)
+        self.config = config
+        self.engine = QueryEngine(use_jit=use_jit if config is None
+                                  else config.use_jit)
+        if config is not None:
+            for t in config.tables:
+                self.engine.register_table(t.name, make_provider(t))
+
+    # --- registration ---
+
+    def register_table(self, name: str, table) -> "Session":
+        """Register a pyarrow Table, pandas DataFrame, or TableProvider."""
+        if hasattr(table, "to_arrow"):  # pandas-like via pyarrow
+            table = pa.Table.from_pandas(table)
+        elif not isinstance(table, pa.Table) and hasattr(table, "columns") \
+                and hasattr(table, "index"):
+            table = pa.Table.from_pandas(table)
+        self.engine.register_table(name, table)
+        return self
+
+    def register_parquet(self, name: str, path: str) -> "Session":
+        from igloo_tpu.connectors.parquet import ParquetTable
+        self.engine.register_table(name, ParquetTable(path))
+        return self
+
+    def register_csv(self, name: str, path: str, **opts) -> "Session":
+        from igloo_tpu.connectors.csv import CsvTable
+        self.engine.register_table(name, CsvTable(path, **opts))
+        return self
+
+    def register_iceberg(self, name: str, path: str) -> "Session":
+        from igloo_tpu.connectors.iceberg import IcebergTable
+        self.engine.register_table(name, IcebergTable(path))
+        return self
+
+    def deregister(self, name: str) -> "Session":
+        self.engine.deregister_table(name)
+        return self
+
+    # --- queries ---
+
+    def sql(self, query: str) -> pa.Table:
+        return self.engine.execute(query)
+
+    def query(self, query: str) -> QueryResult:
+        return self.engine.query(query)
+
+    def explain(self, query: str) -> str:
+        t = self.engine.execute(f"EXPLAIN {query}")
+        return "\n".join(t.column("plan").to_pylist())
+
+    def tables(self) -> list[str]:
+        return self.engine.catalog.names()

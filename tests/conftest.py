@@ -3,7 +3,9 @@ sharding/shuffle paths execute in CI without TPUs (SURVEY.md §4 test strategy (
 the reference has no distributed tests at all — we invent the strategy here)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the ambient environment points JAX at a TPU: the suite
+# simulates an 8-chip mesh and must not eat real-chip compile latency
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

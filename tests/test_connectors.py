@@ -1,0 +1,309 @@
+"""Connector tests: Parquet (row-group pruning), CSV, Iceberg (real metadata via
+the Avro reader), DBAPI federation (against sqlite3 as the stand-in driver)."""
+import json
+import os
+import sqlite3
+import struct
+import zlib
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from igloo_tpu import types as T
+from igloo_tpu.connectors.avro import read_avro_file
+from igloo_tpu.connectors.csv import CsvTable
+from igloo_tpu.connectors.dbapi import DbApiTable
+from igloo_tpu.connectors.iceberg import IcebergTable
+from igloo_tpu.connectors.parquet import ParquetTable
+from igloo_tpu.engine import QueryEngine
+from igloo_tpu.errors import ConnectorError
+from igloo_tpu.plan import expr as E
+
+
+# --- minimal avro writer (tests only): exercises the reader against real bytes
+
+
+def _zz(n: int) -> bytes:
+    n = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _avro_str(s: str) -> bytes:
+    b = s.encode()
+    return _zz(len(b)) + b
+
+
+def _encode_record(schema, rec) -> bytes:
+    out = b""
+    for f in schema["fields"]:
+        out += _encode_value(f["type"], rec[f["name"]])
+    return out
+
+
+def _encode_value(sch, v) -> bytes:
+    if isinstance(sch, list):  # union: pick branch by value
+        for i, branch in enumerate(sch):
+            bt = branch if isinstance(branch, str) else branch.get("type")
+            if v is None and bt == "null":
+                return _zz(i)
+            if v is not None and bt != "null":
+                return _zz(i) + _encode_value(branch, v)
+        raise AssertionError("no union branch")
+    t = sch if isinstance(sch, str) else sch["type"]
+    if t == "string":
+        return _avro_str(v)
+    if t in ("int", "long"):
+        return _zz(v)
+    if t == "double":
+        return struct.pack("<d", v)
+    if t == "boolean":
+        return b"\x01" if v else b"\x00"
+    if t == "record":
+        return _encode_record(sch, v)
+    if t == "array":
+        out = b""
+        if v:
+            out += _zz(len(v))
+            for item in v:
+                out += _encode_value(sch["items"], item)
+        return out + _zz(0)
+    raise AssertionError(f"test writer: type {t}")
+
+
+def write_avro(path, schema, records, codec="null"):
+    sync = b"0123456789abcdef"
+    body = b"".join(_encode_record(schema, r) for r in records)
+    if codec == "deflate":
+        comp = zlib.compressobj(wbits=-15)
+        body = comp.compress(body) + comp.flush()
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    with open(path, "wb") as fh:
+        fh.write(b"Obj\x01")
+        fh.write(_zz(len(meta)))
+        for k, v in meta.items():
+            fh.write(_avro_str(k) + _zz(len(v)) + v)
+        fh.write(_zz(0))
+        fh.write(sync)
+        fh.write(_zz(len(records)) + _zz(len(body)) + body + sync)
+
+
+class TestAvro:
+    def test_roundtrip(self, tmp_path):
+        schema = {"type": "record", "name": "r", "fields": [
+            {"name": "a", "type": "long"},
+            {"name": "s", "type": "string"},
+            {"name": "maybe", "type": ["null", "double"]},
+            {"name": "tags", "type": {"type": "array", "items": "string"}},
+        ]}
+        recs = [{"a": -3, "s": "héllo", "maybe": None, "tags": ["x", "y"]},
+                {"a": 12345678901, "s": "", "maybe": 2.5, "tags": []}]
+        p = tmp_path / "t.avro"
+        write_avro(str(p), schema, recs)
+        assert read_avro_file(str(p)) == recs
+
+    def test_deflate(self, tmp_path):
+        schema = {"type": "record", "name": "r", "fields": [
+            {"name": "a", "type": "long"}]}
+        recs = [{"a": i} for i in range(100)]
+        p = tmp_path / "t.avro"
+        write_avro(str(p), schema, recs, codec="deflate")
+        assert read_avro_file(str(p)) == recs
+
+
+class TestParquet:
+    def test_row_group_pruning(self, tmp_path):
+        t = pa.table({"a": pa.array(range(1000), type=pa.int64())})
+        p = tmp_path / "t.parquet"
+        pq.write_table(t, p, row_group_size=100)
+        pt = ParquetTable(str(p))
+        lit = E.Literal(value=950, literal_type=T.INT64)
+        col = E.Column("a", index=0)
+        pred = E.Binary(op=E.BinOp.GT, left=col, right=lit)
+        out = pt.read(filters=[pred])
+        # only the last row group (900-999) survives pruning
+        assert out.num_rows == 100
+        assert pt.read(filters=None).num_rows == 1000
+
+    def test_directory_and_partitions(self, tmp_path):
+        for i in range(3):
+            pq.write_table(pa.table({"a": pa.array([i], type=pa.int64())}),
+                           tmp_path / f"part{i}.parquet")
+        pt = ParquetTable(str(tmp_path))
+        assert pt.num_partitions() == 3
+        assert pt.read().num_rows == 3
+        assert pt.read_partition(1).num_rows == 1
+
+    def test_fake_parquet_is_clean_error(self, tmp_path):
+        # the reference ships a text placeholder as .parquet (gap G8); reading
+        # one must be a clean ConnectorError, not a crash
+        p = tmp_path / "fake.parquet"
+        p.write_text("this is not parquet\n")
+        with pytest.raises(ConnectorError):
+            ParquetTable(str(p))
+
+
+class TestCsv:
+    def test_with_and_without_header(self, tmp_path):
+        p = tmp_path / "t.csv"
+        p.write_text("col_a,col_b\n1,foo\n2,bar\n")
+        ct = CsvTable(str(p))
+        assert ct.schema().names == ["col_a", "col_b"]
+        assert ct.read().num_rows == 2
+        p2 = tmp_path / "nh.csv"
+        p2.write_text("1,foo\n2,bar\n")
+        ct2 = CsvTable(str(p2), has_header=False)
+        assert ct2.schema().names == ["column_1", "column_2"]
+        assert ct2.read().num_rows == 2
+
+    def test_missing_file(self, tmp_path):
+        with pytest.raises(ConnectorError):
+            CsvTable(str(tmp_path / "missing.csv"))
+
+    def test_through_engine(self, tmp_path):
+        p = tmp_path / "t.csv"
+        p.write_text("k,v\nx,1\ny,2\nx,3\n")
+        e = QueryEngine()
+        e.register_table("c", CsvTable(str(p)))
+        out = e.execute("SELECT k, sum(v) AS s FROM c GROUP BY k ORDER BY k")
+        assert out.column("k").to_pylist() == ["x", "y"]
+        assert out.column("s").to_pylist() == [4, 2]
+
+
+def _make_iceberg_table(root, with_deleted=False):
+    """Build a real (v1-flavor) iceberg layout: metadata json + avro manifest
+    list + avro manifest + parquet data files."""
+    os.makedirs(root / "metadata")
+    os.makedirs(root / "data")
+    live = root / "data" / "f1.parquet"
+    pq.write_table(pa.table({"a": pa.array([1, 2], type=pa.int64())}), live)
+    live2 = root / "data" / "f2.parquet"
+    pq.write_table(pa.table({"a": pa.array([3], type=pa.int64())}), live2)
+    orphan = root / "data" / "orphan.parquet"  # NOT in any manifest
+    pq.write_table(pa.table({"a": pa.array([99], type=pa.int64())}), orphan)
+
+    manifest_schema = {
+        "type": "record", "name": "manifest_entry", "fields": [
+            {"name": "status", "type": "int"},
+            {"name": "data_file", "type": {
+                "type": "record", "name": "data_file", "fields": [
+                    {"name": "content", "type": "int"},
+                    {"name": "file_path", "type": "string"},
+                    {"name": "record_count", "type": "long"},
+                ]}},
+        ]}
+    entries = [
+        {"status": 1, "data_file": {"content": 0,
+                                    "file_path": str(live), "record_count": 2}},
+        {"status": 1, "data_file": {"content": 0,
+                                    "file_path": str(live2), "record_count": 1}},
+    ]
+    if with_deleted:
+        entries.append({"status": 2, "data_file": {
+            "content": 0, "file_path": str(live2), "record_count": 1}})
+    manifest = root / "metadata" / "m1.avro"
+    write_avro(str(manifest), manifest_schema, entries)
+
+    mlist_schema = {
+        "type": "record", "name": "manifest_file", "fields": [
+            {"name": "manifest_path", "type": "string"},
+            {"name": "manifest_length", "type": "long"},
+        ]}
+    mlist = root / "metadata" / "snap-1.avro"
+    write_avro(str(mlist), mlist_schema,
+               [{"manifest_path": str(manifest),
+                 "manifest_length": os.path.getsize(manifest)}])
+
+    meta = {
+        "format-version": 2,
+        "current-snapshot-id": 1,
+        "snapshots": [{"snapshot-id": 1, "manifest-list": str(mlist)}],
+    }
+    (root / "metadata" / "v1.metadata.json").write_text(json.dumps(meta))
+    (root / "metadata" / "version-hint.text").write_text("1")
+
+
+class TestIceberg:
+    def test_manifest_driven_scan_ignores_orphans(self, tmp_path):
+        # the reference globs data/ and would read the orphan file too; real
+        # metadata handling must not
+        _make_iceberg_table(tmp_path)
+        it = IcebergTable(str(tmp_path))
+        out = it.read()
+        assert sorted(out.column("a").to_pylist()) == [1, 2, 3]
+
+    def test_deleted_entries_skipped(self, tmp_path):
+        _make_iceberg_table(tmp_path, with_deleted=True)
+        it = IcebergTable(str(tmp_path))
+        # f2 appears once live and once deleted: both manifest orders exist in
+        # the wild; our reader honors entry status (here: keeps the live one)
+        assert sorted(it.read().column("a").to_pylist())[:2] == [1, 2]
+
+    def test_glob_fallback_without_metadata(self, tmp_path):
+        os.makedirs(tmp_path / "data")
+        pq.write_table(pa.table({"a": pa.array([7], type=pa.int64())}),
+                       tmp_path / "data" / "x.parquet")
+        it = IcebergTable(str(tmp_path))
+        assert it.read().column("a").to_pylist() == [7]
+
+    def test_missing_table_errors(self, tmp_path):
+        with pytest.raises(ConnectorError):
+            IcebergTable(str(tmp_path / "nope"))
+
+    def test_through_engine(self, tmp_path):
+        _make_iceberg_table(tmp_path)
+        e = QueryEngine()
+        e.register_table("ice", IcebergTable(str(tmp_path)))
+        out = e.execute("SELECT sum(a) AS s FROM ice WHERE a > 1")
+        assert out.column("s").to_pylist() == [5]
+
+
+class TestDbApi:
+    def _sqlite_table(self, tmp_path):
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE items (id INTEGER, name TEXT, price REAL)")
+        conn.executemany("INSERT INTO items VALUES (?, ?, ?)",
+                         [(1, "a", 1.5), (2, "b", 2.5), (3, "c", 9.0)])
+        conn.commit()
+        conn.close()
+        return DbApiTable(lambda: sqlite3.connect(db), "items")
+
+    def test_projection_and_filter_pushdown(self, tmp_path):
+        t = self._sqlite_table(tmp_path)
+        lit = E.Literal(value=2.0, literal_type=T.FLOAT64)
+        col = E.Column("price", index=0)
+        pred = E.Binary(op=E.BinOp.GT, left=col, right=lit)
+        out = t.read(projection=["id", "price"], filters=[pred])
+        assert out.column_names == ["id", "price"]
+        assert sorted(out.column("id").to_pylist()) == [2, 3]
+
+    def test_federated_join_through_engine(self, tmp_path):
+        # federation: remote sqlite table joined against a local arrow table
+        e = QueryEngine()
+        e.register_table("remote", self._sqlite_table(tmp_path))
+        e.register_table("local", pa.table({
+            "id": pa.array([1, 3], type=pa.int64()),
+            "tag": ["x", "z"]}))
+        out = e.execute("""
+            SELECT l.tag, r.name FROM local l JOIN remote r ON l.id = r.id
+            ORDER BY l.tag
+        """)
+        assert out.column("tag").to_pylist() == ["x", "z"]
+        assert out.column("name").to_pylist() == ["a", "c"]
+
+    def test_drivers_absent_is_clean_error(self):
+        from igloo_tpu.connectors.dbapi import MySqlTable, PostgresTable
+        with pytest.raises(ConnectorError, match="psycopg2"):
+            PostgresTable("dsn", "t")
+        with pytest.raises(ConnectorError, match="pymysql"):
+            MySqlTable("t")
