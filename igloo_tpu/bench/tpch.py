@@ -1,0 +1,342 @@
+"""TPC-H data generator + query set.
+
+The reference has no benchmark harness at all (SURVEY.md §6: no benches/, no
+criterion, README claims only). This module provides the driver for BASELINE.md:
+a vectorized (numpy) TPC-H dbgen-alike producing the 8 tables at any scale
+factor as Arrow tables, and the query text for the engine's supported dialect.
+
+Distributions follow the TPC-H spec shapes (uniform keys, date ranges
+1992-01-01..1998-12-01, discount/tax ranges, comment strings from a small word
+pool); exact dbgen bit-compatibility is NOT a goal — correctness tests compare
+against a pandas oracle over the SAME generated data, and benchmarks only need
+realistic cardinalities/selectivities.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+
+import numpy as np
+import pyarrow as pa
+
+_EPOCH = _dt.date(1970, 1, 1)
+
+
+def _days(y, m, d):
+    return (_dt.date(y, m, d) - _EPOCH).days
+
+
+_START = _days(1992, 1, 1)
+_END = _days(1998, 12, 1)
+
+_NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+_SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+_INSTRUCTIONS = ["COLLECT COD", "DELIVER IN PERSON", "NONE",
+                 "TAKE BACK RETURN"]
+_TYPES_P1 = ["ECONOMY", "LARGE", "MEDIUM", "PROMO", "SMALL", "STANDARD"]
+_TYPES_P2 = ["ANODIZED", "BRUSHED", "BURNISHED", "PLATED", "POLISHED"]
+_TYPES_P3 = ["BRASS", "COPPER", "NICKEL", "STEEL", "TIN"]
+_CONTAINERS_P1 = ["JUMBO", "LG", "MED", "SM", "WRAP"]
+_CONTAINERS_P2 = ["BAG", "BOX", "CAN", "CASE", "DRUM", "JAR", "PACK", "PKG"]
+_WORDS = ("the quick final pending special express regular furious ironic "
+          "bold even silent slow careful deposits requests accounts foxes "
+          "packages theodolites instructions pinto beans").split()
+
+
+def _comments(rng, n, lo=2, hi=6):
+    k = rng.integers(lo, hi + 1, n)
+    idx = rng.integers(0, len(_WORDS), (n, hi))
+    return [" ".join(_WORDS[idx[i, j]] for j in range(k[i])) for i in range(n)]
+
+
+def _money(rng, n, lo, hi):
+    # decimal(15,2): generate in cents, expose as float64 (engine computes f64)
+    cents = rng.integers(int(lo * 100), int(hi * 100) + 1, n)
+    return cents.astype(np.float64) / 100.0
+
+
+def gen_tables(sf: float = 0.01, seed: int = 19980401) -> dict[str, pa.Table]:
+    rng = np.random.default_rng(seed)
+    out: dict[str, pa.Table] = {}
+
+    out["region"] = pa.table({
+        "r_regionkey": pa.array(np.arange(5), type=pa.int64()),
+        "r_name": _REGIONS,
+        "r_comment": _comments(rng, 5),
+    })
+
+    n_nation = len(_NATIONS)
+    out["nation"] = pa.table({
+        "n_nationkey": pa.array(np.arange(n_nation), type=pa.int64()),
+        "n_name": [n for n, _ in _NATIONS],
+        "n_regionkey": pa.array([r for _, r in _NATIONS], type=pa.int64()),
+        "n_comment": _comments(rng, n_nation),
+    })
+
+    n_supp = max(int(10_000 * sf), 10)
+    s_nation = rng.integers(0, n_nation, n_supp)
+    out["supplier"] = pa.table({
+        "s_suppkey": pa.array(np.arange(1, n_supp + 1), type=pa.int64()),
+        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+        "s_address": _comments(rng, n_supp, 1, 3),
+        "s_nationkey": pa.array(s_nation, type=pa.int64()),
+        "s_phone": [f"{10 + s_nation[i]}-{rng.integers(100, 999)}-"
+                    f"{rng.integers(100, 999)}-{rng.integers(1000, 9999)}"
+                    for i in range(n_supp)],
+        "s_acctbal": _money(rng, n_supp, -999.99, 9999.99),
+        "s_comment": _comments(rng, n_supp),
+    })
+
+    n_part = max(int(200_000 * sf), 20)
+    p_types = [f"{_TYPES_P1[rng.integers(0, 6)]} "
+               f"{_TYPES_P2[rng.integers(0, 5)]} "
+               f"{_TYPES_P3[rng.integers(0, 5)]}" for _ in range(n_part)]
+    out["part"] = pa.table({
+        "p_partkey": pa.array(np.arange(1, n_part + 1), type=pa.int64()),
+        "p_name": [" ".join(rng.choice(_WORDS, 3)) for _ in range(n_part)],
+        "p_mfgr": [f"Manufacturer#{rng.integers(1, 6)}" for _ in range(n_part)],
+        "p_brand": [f"Brand#{rng.integers(1, 6)}{rng.integers(1, 6)}"
+                    for _ in range(n_part)],
+        "p_type": p_types,
+        "p_size": pa.array(rng.integers(1, 51, n_part), type=pa.int64()),
+        "p_container": [f"{_CONTAINERS_P1[rng.integers(0, 5)]} "
+                        f"{_CONTAINERS_P2[rng.integers(0, 8)]}"
+                        for _ in range(n_part)],
+        "p_retailprice": _money(rng, n_part, 900.0, 2000.0),
+        "p_comment": _comments(rng, n_part, 1, 3),
+    })
+
+    n_ps = n_part * 4
+    ps_part = np.repeat(np.arange(1, n_part + 1), 4)
+    ps_supp = ((ps_part + np.tile(np.arange(4), n_part) *
+                (n_supp // 4 + 1)) % n_supp) + 1
+    out["partsupp"] = pa.table({
+        "ps_partkey": pa.array(ps_part, type=pa.int64()),
+        "ps_suppkey": pa.array(ps_supp, type=pa.int64()),
+        "ps_availqty": pa.array(rng.integers(1, 10_000, n_ps), type=pa.int64()),
+        "ps_supplycost": _money(rng, n_ps, 1.0, 1000.0),
+        "ps_comment": _comments(rng, n_ps),
+    })
+
+    n_cust = max(int(150_000 * sf), 15)
+    c_nation = rng.integers(0, n_nation, n_cust)
+    out["customer"] = pa.table({
+        "c_custkey": pa.array(np.arange(1, n_cust + 1), type=pa.int64()),
+        "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+        "c_address": _comments(rng, n_cust, 1, 3),
+        "c_nationkey": pa.array(c_nation, type=pa.int64()),
+        "c_phone": [f"{10 + c_nation[i]}-{rng.integers(100, 999)}-"
+                    f"{rng.integers(100, 999)}-{rng.integers(1000, 9999)}"
+                    for i in range(n_cust)],
+        "c_acctbal": _money(rng, n_cust, -999.99, 9999.99),
+        "c_mktsegment": [_SEGMENTS[i] for i in rng.integers(0, 5, n_cust)],
+        "c_comment": _comments(rng, n_cust),
+    })
+
+    n_ord = max(int(1_500_000 * sf), 150)
+    o_cust = rng.integers(1, n_cust + 1, n_ord)
+    o_date = rng.integers(_START, _END - 151, n_ord)
+    out["orders"] = pa.table({
+        "o_orderkey": pa.array(np.arange(1, n_ord + 1), type=pa.int64()),
+        "o_custkey": pa.array(o_cust, type=pa.int64()),
+        "o_orderstatus": [["F", "O", "P"][i] for i in rng.integers(0, 3, n_ord)],
+        "o_totalprice": _money(rng, n_ord, 800.0, 500_000.0),
+        "o_orderdate": pa.array(o_date.astype("int32"), type=pa.int32()).cast(
+            pa.date32()),
+        "o_orderpriority": [_PRIORITIES[i] for i in rng.integers(0, 5, n_ord)],
+        "o_clerk": [f"Clerk#{rng.integers(1, 1001):09d}" for _ in range(n_ord)],
+        "o_shippriority": pa.array(np.zeros(n_ord, dtype=np.int64)),
+        "o_comment": _comments(rng, n_ord),
+    })
+
+    # lineitem: 1-7 lines per order
+    lines_per = rng.integers(1, 8, n_ord)
+    n_li = int(lines_per.sum())
+    li_order = np.repeat(np.arange(1, n_ord + 1), lines_per)
+    li_odate = np.repeat(o_date, lines_per)
+    linenumber = np.concatenate([np.arange(1, k + 1) for k in lines_per])
+    qty = rng.integers(1, 51, n_li).astype(np.float64)
+    partkey = rng.integers(1, n_part + 1, n_li)
+    # extendedprice = qty * part retail-ish price
+    base_price = 900.0 + (partkey % 1000) * 1.1
+    extended = np.round(qty * base_price, 2)
+    discount = rng.integers(0, 11, n_li).astype(np.float64) / 100.0
+    tax = rng.integers(0, 9, n_li).astype(np.float64) / 100.0
+    ship = li_odate + rng.integers(1, 122, n_li)
+    commit = li_odate + rng.integers(30, 91, n_li)
+    receipt = ship + rng.integers(1, 31, n_li)
+    returnflag = np.where(receipt <= _days(1995, 6, 17),
+                          np.where(rng.random(n_li) < 0.5, "R", "A"), "N")
+    linestatus = np.where(ship > _days(1995, 6, 17), "O", "F")
+    out["lineitem"] = pa.table({
+        "l_orderkey": pa.array(li_order, type=pa.int64()),
+        "l_partkey": pa.array(partkey, type=pa.int64()),
+        "l_suppkey": pa.array(((partkey + linenumber) % n_supp) + 1,
+                              type=pa.int64()),
+        "l_linenumber": pa.array(linenumber, type=pa.int64()),
+        "l_quantity": qty,
+        "l_extendedprice": extended,
+        "l_discount": discount,
+        "l_tax": tax,
+        "l_returnflag": returnflag.tolist(),
+        "l_linestatus": linestatus.tolist(),
+        "l_shipdate": pa.array(ship.astype("int32"), type=pa.int32()).cast(
+            pa.date32()),
+        "l_commitdate": pa.array(commit.astype("int32"), type=pa.int32()).cast(
+            pa.date32()),
+        "l_receiptdate": pa.array(receipt.astype("int32"),
+                                  type=pa.int32()).cast(pa.date32()),
+        "l_shipinstruct": [_INSTRUCTIONS[i] for i in rng.integers(0, 4, n_li)],
+        "l_shipmode": [_SHIPMODES[i] for i in rng.integers(0, 7, n_li)],
+        "l_comment": _comments(rng, n_li, 1, 3),
+    })
+    return out
+
+
+def register_all(engine, tables: dict[str, pa.Table]) -> None:
+    for name, t in tables.items():
+        engine.register_table(name, t)
+
+
+# --- query text (engine dialect) --------------------------------------------
+
+QUERIES: dict[str, str] = {
+    "q1": """
+        SELECT l_returnflag, l_linestatus,
+               sum(l_quantity) AS sum_qty,
+               sum(l_extendedprice) AS sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+               avg(l_quantity) AS avg_qty,
+               avg(l_extendedprice) AS avg_price,
+               avg(l_discount) AS avg_disc,
+               count(*) AS count_order
+        FROM lineitem
+        WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+    """,
+    "q3": """
+        SELECT l_orderkey,
+               sum(l_extendedprice * (1 - l_discount)) AS revenue,
+               o_orderdate, o_shippriority
+        FROM customer, orders, lineitem
+        WHERE c_mktsegment = 'BUILDING'
+          AND c_custkey = o_custkey AND l_orderkey = o_orderkey
+          AND o_orderdate < DATE '1995-03-15' AND l_shipdate > DATE '1995-03-15'
+        GROUP BY l_orderkey, o_orderdate, o_shippriority
+        ORDER BY revenue DESC, o_orderdate LIMIT 10
+    """,
+    "q4": """
+        SELECT o_orderpriority, count(*) AS order_count
+        FROM orders
+        WHERE o_orderdate >= DATE '1993-07-01'
+          AND o_orderdate < DATE '1993-07-01' + INTERVAL '3' MONTH
+          AND EXISTS (SELECT 1 FROM lineitem
+                      WHERE l_orderkey = o_orderkey
+                        AND l_commitdate < l_receiptdate)
+        GROUP BY o_orderpriority ORDER BY o_orderpriority
+    """,
+    "q5": """
+        SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM customer, orders, lineitem, supplier, nation, region
+        WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+          AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+          AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+          AND r_name = 'ASIA'
+          AND o_orderdate >= DATE '1994-01-01'
+          AND o_orderdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+        GROUP BY n_name ORDER BY revenue DESC
+    """,
+    "q6": """
+        SELECT sum(l_extendedprice * l_discount) AS revenue
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+          AND l_discount BETWEEN 0.05 AND 0.07
+          AND l_quantity < 24
+    """,
+    "q10": """
+        SELECT c_custkey, c_name,
+               sum(l_extendedprice * (1 - l_discount)) AS revenue,
+               c_acctbal, n_name, c_address, c_phone, c_comment
+        FROM customer, orders, lineitem, nation
+        WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+          AND o_orderdate >= DATE '1993-10-01'
+          AND o_orderdate < DATE '1993-10-01' + INTERVAL '3' MONTH
+          AND l_returnflag = 'R' AND c_nationkey = n_nationkey
+        GROUP BY c_custkey, c_name, c_acctbal, c_phone, n_name, c_address,
+                 c_comment
+        ORDER BY revenue DESC LIMIT 20
+    """,
+    "q12": """
+        SELECT l_shipmode,
+               sum(CASE WHEN o_orderpriority = '1-URGENT'
+                         OR o_orderpriority = '2-HIGH' THEN 1 ELSE 0 END)
+                   AS high_line_count,
+               sum(CASE WHEN o_orderpriority <> '1-URGENT'
+                        AND o_orderpriority <> '2-HIGH' THEN 1 ELSE 0 END)
+                   AS low_line_count
+        FROM orders, lineitem
+        WHERE o_orderkey = l_orderkey
+          AND l_shipmode IN ('MAIL', 'SHIP')
+          AND l_commitdate < l_receiptdate AND l_shipdate < l_commitdate
+          AND l_receiptdate >= DATE '1994-01-01'
+          AND l_receiptdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+        GROUP BY l_shipmode ORDER BY l_shipmode
+    """,
+    "q14": """
+        SELECT 100.00 * sum(CASE WHEN p_type LIKE 'PROMO%'
+                                 THEN l_extendedprice * (1 - l_discount)
+                                 ELSE 0 END)
+               / sum(l_extendedprice * (1 - l_discount)) AS promo_revenue
+        FROM lineitem, part
+        WHERE l_partkey = p_partkey
+          AND l_shipdate >= DATE '1995-09-01'
+          AND l_shipdate < DATE '1995-09-01' + INTERVAL '1' MONTH
+    """,
+    "q16": """
+        SELECT p_brand, p_type, p_size,
+               count(DISTINCT ps_suppkey) AS supplier_cnt
+        FROM partsupp, part
+        WHERE p_partkey = ps_partkey AND p_brand <> 'Brand#45'
+          AND p_size IN (49, 14, 23, 45, 19, 3, 36, 9)
+          AND ps_suppkey NOT IN (SELECT s_suppkey FROM supplier
+                                 WHERE s_comment LIKE '%pending%')
+        GROUP BY p_brand, p_type, p_size
+        ORDER BY supplier_cnt DESC, p_brand, p_type, p_size
+        LIMIT 20
+    """,
+    "q18": """
+        SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               sum(l_quantity) AS total_qty
+        FROM customer, orders, lineitem
+        WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem
+                             GROUP BY l_orderkey HAVING sum(l_quantity) > 150)
+          AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+        GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        ORDER BY o_totalprice DESC, o_orderdate LIMIT 100
+    """,
+    "q19": """
+        SELECT sum(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM lineitem, part
+        WHERE p_partkey = l_partkey
+          AND ((p_brand = 'Brand#12'
+                AND l_quantity >= 1 AND l_quantity <= 11 AND p_size BETWEEN 1 AND 5)
+            OR (p_brand = 'Brand#23'
+                AND l_quantity >= 10 AND l_quantity <= 20 AND p_size BETWEEN 1 AND 10)
+            OR (p_brand = 'Brand#34'
+                AND l_quantity >= 20 AND l_quantity <= 30 AND p_size BETWEEN 1 AND 15))
+          AND l_shipmode IN ('AIR', 'REG AIR')
+    """,
+}

@@ -1,0 +1,208 @@
+"""TPC-H golden tests: every supported query runs through the engine and is
+checked against a pandas oracle over the same generated data (SURVEY.md §4
+test plan (c))."""
+import datetime as _dt
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from igloo_tpu.bench.tpch import QUERIES, gen_tables, register_all
+from igloo_tpu.engine import QueryEngine
+
+
+@pytest.fixture(scope="module")
+def env():
+    tables = gen_tables(sf=0.002, seed=7)
+    engine = QueryEngine()
+    register_all(engine, tables)
+    dfs = {k: v.to_pandas() for k, v in tables.items()}
+    return engine, dfs
+
+
+def _d(y, m, d):
+    return _dt.date(y, m, d)
+
+
+def _rev(df):
+    return df.l_extendedprice * (1 - df.l_discount)
+
+
+def run(engine, qid):
+    return QUERIES[qid] and engine.execute(QUERIES[qid]).to_pandas()
+
+
+class TestTpch:
+    def test_q1(self, env):
+        engine, dfs = env
+        got = run(engine, "q1")
+        li = dfs["lineitem"]
+        cut = _d(1998, 12, 1) - _dt.timedelta(days=90)
+        f = li[li.l_shipdate <= cut]
+        want = f.groupby(["l_returnflag", "l_linestatus"]).agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            count_order=("l_quantity", "size"),
+            avg_disc=("l_discount", "mean"),
+        ).reset_index().sort_values(["l_returnflag", "l_linestatus"])
+        assert got["l_returnflag"].tolist() == want["l_returnflag"].tolist()
+        np.testing.assert_allclose(got["sum_qty"], want["sum_qty"], rtol=1e-9)
+        np.testing.assert_allclose(got["sum_base_price"],
+                                   want["sum_base_price"], rtol=1e-9)
+        np.testing.assert_allclose(got["avg_disc"], want["avg_disc"], rtol=1e-9)
+        assert got["count_order"].tolist() == want["count_order"].tolist()
+        sdp = f.assign(r=_rev(f)).groupby(
+            ["l_returnflag", "l_linestatus"]).r.sum().reset_index() \
+            .sort_values(["l_returnflag", "l_linestatus"])
+        np.testing.assert_allclose(got["sum_disc_price"], sdp["r"], rtol=1e-9)
+
+    def test_q3(self, env):
+        engine, dfs = env
+        got = run(engine, "q3")
+        c, o, li = dfs["customer"], dfs["orders"], dfs["lineitem"]
+        j = c[c.c_mktsegment == "BUILDING"].merge(
+            o, left_on="c_custkey", right_on="o_custkey")
+        j = j[j.o_orderdate < _d(1995, 3, 15)]
+        j = j.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        j = j[j.l_shipdate > _d(1995, 3, 15)]
+        want = j.assign(revenue=_rev(j)).groupby(
+            ["l_orderkey", "o_orderdate", "o_shippriority"]).revenue.sum() \
+            .reset_index().sort_values(["revenue", "o_orderdate"],
+                                       ascending=[False, True]).head(10)
+        assert got["l_orderkey"].tolist() == want["l_orderkey"].tolist()
+        np.testing.assert_allclose(got["revenue"], want["revenue"], rtol=1e-9)
+
+    def test_q4(self, env):
+        engine, dfs = env
+        got = run(engine, "q4")
+        o, li = dfs["orders"], dfs["lineitem"]
+        f = o[(o.o_orderdate >= _d(1993, 7, 1)) &
+              (o.o_orderdate < _d(1993, 10, 1))]
+        late = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+        f = f[f.o_orderkey.isin(late)]
+        want = f.groupby("o_orderpriority").size().reset_index(name="n") \
+            .sort_values("o_orderpriority")
+        assert got["o_orderpriority"].tolist() == want["o_orderpriority"].tolist()
+        assert got["order_count"].tolist() == want["n"].tolist()
+
+    def test_q5(self, env):
+        engine, dfs = env
+        got = run(engine, "q5")
+        c, o, li = dfs["customer"], dfs["orders"], dfs["lineitem"]
+        s, n, r = dfs["supplier"], dfs["nation"], dfs["region"]
+        j = c.merge(o, left_on="c_custkey", right_on="o_custkey")
+        j = j[(j.o_orderdate >= _d(1994, 1, 1)) & (j.o_orderdate < _d(1995, 1, 1))]
+        j = j.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        j = j.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        j = j[j.c_nationkey == j.s_nationkey]
+        j = j.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        j = j.merge(r, left_on="n_regionkey", right_on="r_regionkey")
+        j = j[j.r_name == "ASIA"]
+        want = j.assign(revenue=_rev(j)).groupby("n_name").revenue.sum() \
+            .reset_index().sort_values("revenue", ascending=False)
+        assert got["n_name"].tolist() == want["n_name"].tolist()
+        np.testing.assert_allclose(got["revenue"], want["revenue"], rtol=1e-9)
+
+    def test_q6(self, env):
+        engine, dfs = env
+        got = run(engine, "q6")
+        li = dfs["lineitem"]
+        f = li[(li.l_shipdate >= _d(1994, 1, 1)) &
+               (li.l_shipdate < _d(1995, 1, 1)) &
+               (li.l_discount >= 0.05) & (li.l_discount <= 0.07) &
+               (li.l_quantity < 24)]
+        np.testing.assert_allclose(
+            got["revenue"], [(f.l_extendedprice * f.l_discount).sum()],
+            rtol=1e-9)
+
+    def test_q10(self, env):
+        engine, dfs = env
+        got = run(engine, "q10")
+        c, o, li, n = dfs["customer"], dfs["orders"], dfs["lineitem"], dfs["nation"]
+        j = c.merge(o, left_on="c_custkey", right_on="o_custkey")
+        j = j[(j.o_orderdate >= _d(1993, 10, 1)) & (j.o_orderdate < _d(1994, 1, 1))]
+        j = j.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        j = j[j.l_returnflag == "R"]
+        j = j.merge(n, left_on="c_nationkey", right_on="n_nationkey")
+        want = j.assign(revenue=_rev(j)).groupby(
+            ["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+             "c_address", "c_comment"]).revenue.sum().reset_index() \
+            .sort_values("revenue", ascending=False).head(20)
+        assert got["c_custkey"].tolist() == want["c_custkey"].tolist()
+        np.testing.assert_allclose(got["revenue"], want["revenue"], rtol=1e-9)
+
+    def test_q12(self, env):
+        engine, dfs = env
+        got = run(engine, "q12")
+        o, li = dfs["orders"], dfs["lineitem"]
+        j = o.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        j = j[j.l_shipmode.isin(["MAIL", "SHIP"]) &
+              (j.l_commitdate < j.l_receiptdate) &
+              (j.l_shipdate < j.l_commitdate) &
+              (j.l_receiptdate >= _d(1994, 1, 1)) &
+              (j.l_receiptdate < _d(1995, 1, 1))]
+        hi = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+        want = j.assign(h=hi.astype(int), l=(~hi).astype(int)).groupby(
+            "l_shipmode").agg(h=("h", "sum"), l=("l", "sum")).reset_index() \
+            .sort_values("l_shipmode")
+        assert got["l_shipmode"].tolist() == want["l_shipmode"].tolist()
+        assert got["high_line_count"].tolist() == want["h"].tolist()
+        assert got["low_line_count"].tolist() == want["l"].tolist()
+
+    def test_q14(self, env):
+        engine, dfs = env
+        got = run(engine, "q14")
+        li, p = dfs["lineitem"], dfs["part"]
+        j = li.merge(p, left_on="l_partkey", right_on="p_partkey")
+        j = j[(j.l_shipdate >= _d(1995, 9, 1)) & (j.l_shipdate < _d(1995, 10, 1))]
+        promo = j[j.p_type.str.startswith("PROMO")]
+        want = 100.0 * _rev(promo).sum() / _rev(j).sum()
+        np.testing.assert_allclose(got["promo_revenue"], [want], rtol=1e-9)
+
+    def test_q16(self, env):
+        engine, dfs = env
+        got = run(engine, "q16")
+        ps, p, s = dfs["partsupp"], dfs["part"], dfs["supplier"]
+        bad = s[s.s_comment.str.contains("pending")].s_suppkey
+        j = ps.merge(p, left_on="ps_partkey", right_on="p_partkey")
+        j = j[(j.p_brand != "Brand#45") &
+              j.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9]) &
+              ~j.ps_suppkey.isin(bad)]
+        want = j.groupby(["p_brand", "p_type", "p_size"]).ps_suppkey.nunique() \
+            .reset_index(name="supplier_cnt").sort_values(
+                ["supplier_cnt", "p_brand", "p_type", "p_size"],
+                ascending=[False, True, True, True]).head(20)
+        assert got["supplier_cnt"].tolist() == want["supplier_cnt"].tolist()
+        assert got["p_brand"].tolist() == want["p_brand"].tolist()
+
+    def test_q18(self, env):
+        engine, dfs = env
+        got = run(engine, "q18")
+        c, o, li = dfs["customer"], dfs["orders"], dfs["lineitem"]
+        big = li.groupby("l_orderkey").l_quantity.sum()
+        big = big[big > 150].index
+        j = o[o.o_orderkey.isin(big)].merge(
+            c, left_on="o_custkey", right_on="c_custkey")
+        j = j.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        want = j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                          "o_totalprice"]).l_quantity.sum().reset_index() \
+            .sort_values(["o_totalprice", "o_orderdate"],
+                         ascending=[False, True]).head(100)
+        assert got["o_orderkey"].tolist() == want["o_orderkey"].tolist()
+        np.testing.assert_allclose(got["total_qty"], want["l_quantity"],
+                                   rtol=1e-9)
+
+    def test_q19(self, env):
+        engine, dfs = env
+        got = run(engine, "q19")
+        li, p = dfs["lineitem"], dfs["part"]
+        j = li.merge(p, left_on="l_partkey", right_on="p_partkey")
+        j = j[j.l_shipmode.isin(["AIR", "REG AIR"])]
+        m = (((j.p_brand == "Brand#12") & j.l_quantity.between(1, 11) &
+              j.p_size.between(1, 5)) |
+             ((j.p_brand == "Brand#23") & j.l_quantity.between(10, 20) &
+              j.p_size.between(1, 10)) |
+             ((j.p_brand == "Brand#34") & j.l_quantity.between(20, 30) &
+              j.p_size.between(1, 15)))
+        want = _rev(j[m]).sum()
+        np.testing.assert_allclose(got["revenue"], [want], rtol=1e-9)
