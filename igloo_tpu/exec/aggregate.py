@@ -37,9 +37,11 @@ class AggSpec:
 
 
 def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
-                    aggs: list[AggSpec], out_schema: T.Schema) -> DeviceBatch:
-    """Pure, jit-traceable: DeviceBatch -> DeviceBatch of one row per group."""
-    env = Env.from_batch(batch)
+                    aggs: list[AggSpec], out_schema: T.Schema,
+                    consts: tuple = ()) -> DeviceBatch:
+    """Pure, jit-traceable: DeviceBatch -> DeviceBatch of one row per group.
+    Output columns carry no dictionaries — the executor re-attaches them."""
+    env = Env.from_batch(batch, consts)
     cap = batch.capacity
     live = batch.live
 
@@ -90,6 +92,9 @@ def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
     for v, nl, g in zip(gvals, gnulls, groups):
         sv = jnp.take(jnp.take(v, perm), first_pos)
         snl = jnp.take(jnp.take(nl, perm), first_pos) if nl is not None else None
+        # out_dict here is trace-time metadata: correct for eager (direct) use;
+        # under the executor's jit cache it may be stale on a cache hit, so the
+        # executor re-attaches current dictionaries after every call
         out_cols.append(DeviceColumn(g.dtype, sv.astype(g.dtype.device_dtype())
                                      if sv.dtype != g.dtype.device_dtype() else sv,
                                      snl, g.out_dict))

@@ -30,15 +30,60 @@ from igloo_tpu.plan import expr as E
 
 class Env:
     """Column environment a compiled expression reads from: device lanes of the input
-    batch, indexed the same way the binder resolved Column.index."""
+    batch, indexed the same way the binder resolved Column.index, plus the const
+    pool arrays (dictionary-derived LUTs) for this execution."""
 
-    def __init__(self, values: list, nulls: list):
+    def __init__(self, values: list, nulls: list, consts: tuple = ()):
         self.values = values
         self.nulls = nulls
+        self.consts = consts
 
     @staticmethod
-    def from_batch(batch: DeviceBatch) -> "Env":
-        return Env([c.values for c in batch.columns], [c.nulls for c in batch.columns])
+    def from_batch(batch: DeviceBatch, consts: tuple = ()) -> "Env":
+        return Env([c.values for c in batch.columns],
+                   [c.nulls for c in batch.columns], consts)
+
+
+class ConstPool:
+    """Host-computed arrays (dictionary LUTs, per-entry hash lanes, parsed-cast
+    tables) that compiled expressions read as runtime ARGUMENTS instead of
+    trace-time constants. This is what keeps dictionary CONTENT out of the jit
+    compile-cache key: two executions whose dictionaries differ only in content
+    (same length bucket) reuse one compiled executable and just pass different
+    const arrays (fixes round-1 verdict: DictInfo in static aux forced a full
+    recompile per new dictionary).
+
+    Arrays are padded to power-of-two lengths so the (shape, dtype) signature —
+    which IS part of the cache key — buckets well."""
+
+    def __init__(self):
+        self.arrays: list[np.ndarray] = []
+
+    def add(self, arr: np.ndarray) -> int:
+        arr = np.ascontiguousarray(arr)
+        if arr.ndim == 1:
+            from igloo_tpu.exec.batch import round_capacity
+            cap = round_capacity(max(arr.shape[0], 1))
+            if cap != arr.shape[0]:
+                out = np.zeros((cap,), dtype=arr.dtype)
+                out[: arr.shape[0]] = arr
+                arr = out
+        elif arr.ndim == 2:
+            from igloo_tpu.exec.batch import round_capacity
+            c0 = round_capacity(max(arr.shape[0], 1))
+            c1 = round_capacity(max(arr.shape[1], 1))
+            if (c0, c1) != arr.shape:
+                out = np.zeros((c0, c1), dtype=arr.dtype)
+                out[: arr.shape[0], : arr.shape[1]] = arr
+                arr = out
+        self.arrays.append(arr)
+        return len(self.arrays) - 1
+
+    def signature(self) -> tuple:
+        return tuple((a.shape, str(a.dtype)) for a in self.arrays)
+
+    def device_args(self) -> tuple:
+        return tuple(jnp.asarray(a) for a in self.arrays)
 
 
 @dataclass
@@ -77,6 +122,13 @@ def _remap_ids(ids, lut: np.ndarray):
     if len(lut) == 0:
         return jnp.zeros_like(ids)
     return jnp.take(jnp.asarray(lut), jnp.clip(ids, 0, len(lut) - 1))
+
+
+def _gather_const(ids, lut):
+    """Gather through a (padded) const-pool array passed at runtime. Live-row
+    ids are always < the true dictionary length, so clipping to the padded
+    length is safe; dead lanes gather padding, which nothing reads."""
+    return jnp.take(lut, jnp.clip(ids, 0, lut.shape[0] - 1))
 
 
 def _like_to_regex(pattern: str) -> re.Pattern:
@@ -121,14 +173,22 @@ def days_from_civil_py(y: int, m: int, d: int) -> int:
 
 class ExprCompiler:
     """Compiles bound expressions against a fixed input batch *prototype* (schema +
-    per-column dictionaries). The produced callables are jit-traceable."""
+    per-column dictionaries). The produced callables are jit-traceable.
 
-    def __init__(self, dicts: list):
+    Dictionary-derived values feed the callables through `pool` (see ConstPool);
+    every structural decision that depends on dictionary content (not just its
+    shape) is appended to `marks`, and (pool.signature(), marks) joins the
+    executor's compile-cache key — so a cached executable is only reused when
+    the new compile would have traced the identical program."""
+
+    def __init__(self, dicts: list, pool: Optional[ConstPool] = None):
         self.dicts = dicts  # per input-column Optional[DictInfo]
+        self.pool = pool if pool is not None else ConstPool()
+        self.marks: list = []
 
     @staticmethod
-    def for_batch(batch: DeviceBatch) -> "ExprCompiler":
-        return ExprCompiler([c.dictionary for c in batch.columns])
+    def for_batch(batch: DeviceBatch, pool: Optional[ConstPool] = None) -> "ExprCompiler":
+        return ExprCompiler([c.dictionary for c in batch.columns], pool)
 
     def compile(self, e: E.Expr) -> Compiled:
         m = getattr(self, "_c_" + type(e).__name__.lower(), None)
@@ -176,22 +236,42 @@ class ExprCompiler:
                 return jnp.floor_divide(vals, np.int64(86_400_000_000)).astype(jnp.int32), nulls
             return Compiled(fn, to, None)
         if c.dtype.is_string and not to.is_string:
-            # cast string -> numeric: parse the dictionary host-side
+            # cast string -> numeric/temporal: parse the dictionary host-side
             d = c.out_dict
             dlen = len(d) if d is not None else 0
             parsed = np.zeros(max(dlen, 1), dtype=to.device_dtype())
             bad = np.zeros(max(dlen, 1), dtype=bool)
             for i, v in enumerate(d.values if d else []):
+                if to.is_temporal:
+                    # ISO date/timestamp strings. Unparseable entries become
+                    # NULL (bad-flag), matching the numeric branch below: the
+                    # dictionary covers the WHOLE column as scanned, so entries
+                    # excluded by filters must not poison the query.
+                    import datetime as _dt
+                    try:
+                        if to.id == T.TypeId.DATE32:
+                            dd = _dt.date.fromisoformat(str(v).strip())
+                            parsed[i] = dd.toordinal() - _dt.date(1970, 1, 1).toordinal()
+                        else:
+                            ts = _dt.datetime.fromisoformat(str(v).strip())
+                            if ts.tzinfo is not None:
+                                ts = ts.astimezone(_dt.timezone.utc) \
+                                    .replace(tzinfo=None)
+                            parsed[i] = (ts - _dt.datetime(1970, 1, 1)) \
+                                // _dt.timedelta(microseconds=1)
+                    except (ValueError, TypeError):
+                        bad[i] = True
+                    continue
                 try:
                     parsed[i] = to.device_dtype().type(float(v) if to.is_float else int(float(v)))
                 except (ValueError, TypeError):
                     bad[i] = True
-            pj, bj = jnp.asarray(parsed), jnp.asarray(bad)
+            pi, bi = self.pool.add(parsed), self.pool.add(bad)
 
             def fn(env):
                 vals, nulls = c.fn(env)
-                ids = jnp.clip(vals, 0, len(parsed) - 1)
-                return jnp.take(pj, ids), _or_nulls(nulls, jnp.take(bj, ids))
+                return (_gather_const(vals, env.consts[pi]),
+                        _or_nulls(nulls, _gather_const(vals, env.consts[bi])))
             return Compiled(fn, to, None)
         if not c.dtype.is_string and to.is_string:
             raise ExprCompileError("cast to string is evaluated host-side only")
@@ -338,17 +418,19 @@ class ExprCompiler:
         (dictionary is sorted => ids are lexicographic ranks); otherwise remap both
         through the union dictionary host-side, then compare ids."""
         same = lc.out_dict is rc.out_dict and lc.out_dict is not None
+        self.marks.append(("strcmp_same", same))
         if same:
-            lut_l = lut_r = None
+            li = ri = None
         else:
             _, lut_l, lut_r = _unify_dicts(lc.out_dict, rc.out_dict)
+            li, ri = self.pool.add(lut_l), self.pool.add(lut_r)
 
         def fn(env):
             lv, ln = lc.fn(env)
             rv, rn = rc.fn(env)
-            if lut_l is not None:
-                lv = _remap_ids(lv, lut_l)
-                rv = _remap_ids(rv, lut_r)
+            if li is not None:
+                lv = _gather_const(lv, env.consts[li])
+                rv = _gather_const(rv, env.consts[ri])
             nulls = _or_nulls(ln, rn)
             if op is E.BinOp.EQ:
                 out = lv == rv
@@ -382,7 +464,9 @@ class ExprCompiler:
             luts = []
             for b in branches:
                 bv = b.out_dict.values if b.out_dict is not None else np.asarray([], dtype=object)
-                luts.append(np.searchsorted(ustr, bv.astype(str)).astype(np.int32) if len(bv) else np.zeros(0, np.int32))
+                luts.append(self.pool.add(
+                    np.searchsorted(ustr, bv.astype(str)).astype(np.int32)
+                    if len(bv) else np.zeros(0, np.int32)))
         else:
             luts = None
             out_dict = None
@@ -397,9 +481,10 @@ class ExprCompiler:
                 ev = jnp.zeros(_cap(env), dtype=wd)
                 en = jnp.ones(_cap(env), dtype=bool)
             if luts is not None:
-                vals = [(_remap_ids(v, luts[i]), nn) for i, (v, nn) in enumerate(vals)]
+                vals = [(_gather_const(v, env.consts[luts[i]]), nn)
+                        for i, (v, nn) in enumerate(vals)]
                 if else_c is not None:
-                    ev = _remap_ids(ev, luts[-1])
+                    ev = _gather_const(ev, env.consts[luts[-1]])
             out = ev.astype(wd)
             out_null = en if en is not None else jnp.zeros(_cap(env), bool)
             # fold from last WHEN to first so earlier WHENs win
@@ -427,11 +512,11 @@ class ExprCompiler:
             lut = np.zeros(max(dlen, 1), dtype=bool)
             for i, v in enumerate(d.values if d is not None else []):
                 lut[i] = v in item_vals
-            lj = jnp.asarray(lut)
+            lj = self.pool.add(lut)
 
             def fn(env):
                 vals, nulls = c.fn(env)
-                out = jnp.take(lj, jnp.clip(vals, 0, len(lut) - 1))
+                out = _gather_const(vals, env.consts[lj])
                 if has_null_item:
                     # x IN (..., NULL): NULL unless a real match; NOT IN never TRUE
                     nulls = _or_nulls(nulls, ~out)
@@ -468,11 +553,11 @@ class ExprCompiler:
             s = str(v).lower() if e.case_insensitive else str(v)
             lut[i] = rx.match(s) is not None
         neg = e.negated
-        lj = jnp.asarray(lut)
+        lj = self.pool.add(lut)
 
         def fn(env):
             vals, nulls = c.fn(env)
-            out = jnp.take(lj, jnp.clip(vals, 0, len(lut) - 1))
+            out = _gather_const(vals, env.consts[lj])
             return (~out if neg else out), nulls
         return Compiled(fn, T.BOOL, None)
 
@@ -506,7 +591,9 @@ class ExprCompiler:
                 luts = []
                 for a in args:
                     av = a.out_dict.values if a.out_dict is not None else np.asarray([], dtype=object)
-                    luts.append(np.searchsorted(ustr, av.astype(str)).astype(np.int32) if len(av) else np.zeros(0, np.int32))
+                    luts.append(self.pool.add(
+                        np.searchsorted(ustr, av.astype(str)).astype(np.int32)
+                        if len(av) else np.zeros(0, np.int32)))
             else:
                 od, luts = None, None
 
@@ -516,7 +603,7 @@ class ExprCompiler:
                 for i, c in enumerate(args):
                     v, nn = c.fn(env)
                     if luts is not None:
-                        v = _remap_ids(v, luts[i])
+                        v = _gather_const(v, env.consts[luts[i]])
                     v = v.astype(out_dtype.device_dtype())
                     if out_v is None:
                         out_v, out_n = v, (nn if nn is not None else jnp.zeros(v.shape, bool))
@@ -529,16 +616,20 @@ class ExprCompiler:
             return Compiled(fn, out_dtype, od)
         if name == "nullif":
             a, b = args
-            if a.dtype.is_string and b.dtype.is_string and a.out_dict is not b.out_dict:
+            unify = a.dtype.is_string and b.dtype.is_string and \
+                a.out_dict is not b.out_dict
+            self.marks.append(("nullif_unify", unify))
+            if unify:
                 _, lut_a, lut_b = _unify_dicts(a.out_dict, b.out_dict)
+                ai, bi = self.pool.add(lut_a), self.pool.add(lut_b)
             else:
-                lut_a = lut_b = None
+                ai = bi = None
 
             def fn(env):
                 av, an = a.fn(env)
                 bv, bn = b.fn(env)
-                acmp = _remap_ids(av, lut_a) if lut_a is not None else av
-                bcmp = _remap_ids(bv, lut_b) if lut_b is not None else bv
+                acmp = _gather_const(av, env.consts[ai]) if ai is not None else av
+                bcmp = _gather_const(bv, env.consts[bi]) if bi is not None else bv
                 eq = (acmp == bcmp) & (~bn if bn is not None else True)
                 return av, _or_nulls(an, eq)
             return Compiled(fn, a.dtype, a.out_dict)
@@ -584,11 +675,12 @@ class ExprCompiler:
             new_vals = [f(str(v)) for v in d.values]
             uniq, inverse = np.unique(np.asarray(new_vals, dtype=object).astype(str), return_inverse=True)
             new_dict = DictInfo.from_values(uniq.astype(object))
-            lut = inverse.astype(np.int32)
+            li = self.pool.add(inverse.astype(np.int32)
+                               if len(new_vals) else np.zeros(0, np.int32))
 
             def fn(env):
                 vals, nulls = c.fn(env)
-                return _remap_ids(vals, lut), nulls
+                return _gather_const(vals, env.consts[li]), nulls
             return Compiled(fn, T.STRING, new_dict)
 
         if name == "upper":
@@ -616,11 +708,11 @@ class ExprCompiler:
             return str_transform(sub)
         if name in ("length", "char_length", "character_length"):
             lens = np.asarray([len(str(v)) for v in d.values], dtype=np.int32)
-            lj = jnp.asarray(lens if len(lens) else np.zeros(1, np.int32))
+            lj = self.pool.add(lens)
 
             def fn(env):
                 vals, nulls = c.fn(env)
-                return jnp.take(lj, jnp.clip(vals, 0, max(len(lens) - 1, 0))), nulls
+                return _gather_const(vals, env.consts[lj]), nulls
             return Compiled(fn, T.INT32, None)
         if name == "concat":
             # concat of string exprs: only dictionary-expressible when arity small;
@@ -643,15 +735,15 @@ class ExprCompiler:
                            for b in (dr.values if len(dr) else [""])], dtype=object)
         uniq, inverse = np.unique(prod.astype(str), return_inverse=True)
         new_dict = DictInfo.from_values(uniq.astype(object))
-        lut = inverse.astype(np.int32).reshape(nl, nr)
-        lj = jnp.asarray(lut)
+        lj = self.pool.add(inverse.astype(np.int32).reshape(nl, nr))
 
         def fn(env):
             lv, ln = lc.fn(env)
             rv, rn = rc.fn(env)
-            li = jnp.clip(lv, 0, nl - 1)
-            ri = jnp.clip(rv, 0, nr - 1)
-            return lj[li, ri], _or_nulls(ln, rn)
+            lut = env.consts[lj]
+            li = jnp.clip(lv, 0, lut.shape[0] - 1)
+            ri = jnp.clip(rv, 0, lut.shape[1] - 1)
+            return lut[li, ri], _or_nulls(ln, rn)
         return Compiled(fn, T.STRING, new_dict)
 
 

@@ -71,20 +71,35 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def _key_lanes(batch: DeviceBatch, keys: list[Compiled]) -> list[_KeyLanes]:
-    env = Env.from_batch(batch)
-    out = []
+def make_key_hash_idxs(keys: list[Compiled], pool) -> list:
+    """Register per-dictionary-entry hash lanes in the const pool for every
+    string-typed key. The hashes feed the jitted probe as runtime data, so a
+    new dictionary (new table contents) never forces a join recompile."""
+    idxs = []
     for k in keys:
+        if k.dtype.is_string:
+            d = k.out_dict
+            h1 = d.hashes.view(np.int64) if d is not None and len(d) \
+                else np.zeros(1, np.int64)
+            h2 = d.hashes2.view(np.int64) if d is not None and len(d) \
+                else np.zeros(1, np.int64)
+            idxs.append((pool.add(h1), pool.add(h2)))
+        else:
+            idxs.append(None)
+    return idxs
+
+
+def _key_lanes(batch: DeviceBatch, keys: list[Compiled], hash_idxs: list,
+               consts: tuple) -> list[_KeyLanes]:
+    env = Env.from_batch(batch, consts)
+    out = []
+    for k, hx in zip(keys, hash_idxs):
         v, nl = k.fn(env)
         if k.dtype.is_string:
             # dictionary hash lanes: equal strings -> equal lanes across tables;
             # 128-bit effective equality with the second lane
-            d = k.out_dict
-            h1 = jnp.asarray(d.hashes.view(np.int64)) if d is not None and len(d) \
-                else jnp.zeros(1, jnp.int64)
-            h2 = jnp.asarray(d.hashes2.view(np.int64)) if d is not None and len(d) \
-                else jnp.zeros(1, jnp.int64)
-            ids = jnp.clip(v, 0, max((len(d) if d else 1) - 1, 0))
+            h1, h2 = consts[hx[0]], consts[hx[1]]
+            ids = jnp.clip(v, 0, h1.shape[0] - 1)
             l1, l2 = jnp.take(h1, ids), jnp.take(h2, ids)
             out.append(_KeyLanes([l1], [l1, l2], nl))
         elif k.dtype.is_float:
@@ -98,12 +113,17 @@ def _key_lanes(batch: DeviceBatch, keys: list[Compiled]) -> list[_KeyLanes]:
 
 
 def probe_phase(left: DeviceBatch, right: DeviceBatch,
-                left_keys: list[Compiled], right_keys: list[Compiled]) -> _Probe:
+                left_keys: list[Compiled], right_keys: list[Compiled],
+                l_hash_idxs=None, r_hash_idxs=None, consts: tuple = ()) -> _Probe:
     """Jit-traceable. CROSS join = empty key lists (constant key)."""
     cap_l, cap_r = left.capacity, right.capacity
+    if l_hash_idxs is None:
+        l_hash_idxs = [None] * len(left_keys)
+    if r_hash_idxs is None:
+        r_hash_idxs = [None] * len(right_keys)
     if left_keys:
-        l_lanes = _key_lanes(left, left_keys)
-        r_lanes = _key_lanes(right, right_keys)
+        l_lanes = _key_lanes(left, left_keys, l_hash_idxs, consts)
+        r_lanes = _key_lanes(right, right_keys, r_hash_idxs, consts)
         l_hash = K.hash_lanes([h for kl in l_lanes for h in kl.hash_ints],
                               [kl.null for kl in l_lanes
                                for _ in kl.hash_ints])
@@ -146,7 +166,7 @@ def _any_null(lanes: list[_KeyLanes], cap) -> jax.Array:
 def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
                  match_cap: int, join_type: JoinType,
                  residual: Optional[Compiled],
-                 out_schema: T.Schema) -> DeviceBatch:
+                 out_schema: T.Schema, consts: tuple = ()) -> DeviceBatch:
     """Jit-traceable (match_cap static). Builds the output batch."""
     cap_l = left.capacity
 
@@ -178,7 +198,7 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         l_cols = K.gather_batch(left, probe_idx)
         r_cols = K.gather_batch(right, r_idx)
         env = Env([c.values for c in l_cols] + [c.values for c in r_cols],
-                  [c.nulls for c in l_cols] + [c.nulls for c in r_cols])
+                  [c.nulls for c in l_cols] + [c.nulls for c in r_cols], consts)
         rv, rn = residual.fn(env)
         ok = ok & rv & (~rn if rn is not None else True)
 
@@ -264,15 +284,26 @@ def join_batches(left: DeviceBatch, right: DeviceBatch,
                  join_type: JoinType, residual: Optional[Compiled],
                  out_schema: T.Schema,
                  probe_jit: Optional[Callable] = None,
-                 expand_jit: Optional[Callable] = None) -> DeviceBatch:
+                 expand_jit: Optional[Callable] = None,
+                 pool=None) -> DeviceBatch:
     """Host-side driver: probe (device) -> one host sync for the candidate count
     -> expand (device). `probe_jit`/`expand_jit` let the executor pass cached
-    jax.jit-wrapped phases; defaults run them eagerly."""
-    pf = probe_jit or probe_phase
-    ef = expand_jit or expand_phase
+    jax.jit-wrapped phases; defaults run them eagerly. `pool` must be the
+    ConstPool the keys/residual were compiled against (a fresh one otherwise);
+    key hash lanes are registered into it."""
+    from igloo_tpu.exec.expr_compile import ConstPool
     if join_type is JoinType.CROSS:
         left_keys, right_keys = [], []
-    p = pf(left, right, left_keys, right_keys)
+    if pool is None:
+        pool = ConstPool()
+    lhx = make_key_hash_idxs(left_keys, pool)
+    rhx = make_key_hash_idxs(right_keys, pool)
+    consts = pool.device_args()
+    pf = probe_jit or (lambda l, r, c: probe_phase(
+        l, r, left_keys, right_keys, lhx, rhx, c))
+    ef = expand_jit or (lambda l, r, p, mc, c: expand_phase(
+        l, r, p, mc, join_type, residual, out_schema, c))
+    p = pf(left, right, consts)
     total = int(p.total)  # the one host sync
     match_cap = choose_match_capacity(total)
-    return ef(left, right, p, match_cap, join_type, residual, out_schema)
+    return ef(left, right, p, match_cap, consts)

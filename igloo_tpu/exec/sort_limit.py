@@ -15,9 +15,9 @@ from igloo_tpu.exec.expr_compile import Compiled, Env
 
 
 def sort_batch(batch: DeviceBatch, keys: list[Compiled], ascending: list[bool],
-               nulls_first: list[bool]) -> DeviceBatch:
+               nulls_first: list[bool], consts: tuple = ()) -> DeviceBatch:
     """Jit-traceable stable sort; dead rows end up last."""
-    env = Env.from_batch(batch)
+    env = Env.from_batch(batch, consts)
     lanes = []
     for k, asc, nf in zip(keys, ascending, nulls_first):
         v, nl = k.fn(env)

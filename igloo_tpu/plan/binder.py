@@ -255,9 +255,12 @@ class Binder:
             (bound_having is not None and self._contains_agg(bound_having)) or \
             bool(bound_groups)
 
+        agg_rewrite = None
+        pre_agg_scope = scope
         if has_aggs:
-            plan, bound_proj, bound_having, scope = self._build_aggregate(
-                plan, scope, bound_groups, bound_proj, bound_having, group_items, names)
+            plan, bound_proj, bound_having, scope, agg_rewrite = \
+                self._build_aggregate(plan, scope, bound_groups, bound_proj,
+                                      bound_having, group_items, names)
 
         if bound_having is not None:
             if bound_having.dtype != T.BOOL:
@@ -278,14 +281,17 @@ class Binder:
 
         plan = self._apply_order_limit(plan, stmt, out_scope,
                                        None if stmt.distinct else proj_node,
-                                       hidden_scope=scope)
+                                       hidden_scope=(pre_agg_scope if agg_rewrite
+                                                     else scope),
+                                       agg_rewrite=agg_rewrite)
         return plan
 
     # --- ORDER BY / LIMIT ---
 
     def _apply_order_limit(self, plan, stmt: A.SelectStmt, out_scope: Scope,
                            proj_node: Optional[L.Project],
-                           hidden_scope: Optional[Scope] = None) -> L.LogicalPlan:
+                           hidden_scope: Optional[Scope] = None,
+                           agg_rewrite=None) -> L.LogicalPlan:
         if stmt.order_by:
             keys, asc, nf = [], [], []
             hidden: list[E.Expr] = []
@@ -294,6 +300,13 @@ class Binder:
                 try:
                     b = self.bind_expr(ex, out_scope, plan)
                 except PlanError:
+                    b = None
+                if b is not None and any(isinstance(n, E.Aggregate)
+                                         for n in E.walk(b)):
+                    # ORDER BY over an aggregate expression: the output scope
+                    # "bind" produced a raw Aggregate node, which only the
+                    # hidden-column path (through the aggregate rewrite) can
+                    # turn into an executable sort key
                     b = None
                 if b is None:
                     if proj_node is None:
@@ -305,6 +318,21 @@ class Binder:
                     in_scope = hidden_scope if hidden_scope is not None \
                         else Scope.from_schema(proj_node.input.schema)
                     hb = self.bind_expr(ex, in_scope, proj_node.input)
+                    if agg_rewrite is not None:
+                        # aggregated query: ORDER BY expressions go through the
+                        # same rewrite HAVING uses — aggregates / group exprs
+                        # map to the Aggregate node's output columns (which may
+                        # grow for ORDER-BY-only aggregates); plain non-grouped
+                        # columns are an error. Re-sync pass-through schemas
+                        # above the (possibly extended) Aggregate node.
+                        hb = agg_rewrite(hb)
+                        chain = []
+                        n = proj_node.input
+                        while isinstance(n, (L.Filter, L.Distinct)):
+                            chain.append(n)
+                            n = n.input
+                        for f in reversed(chain):
+                            f.schema = f.input.schema
                     hname = f"__sort_{len(hidden)}"
                     hidden.append(hb)
                     proj_node.exprs.append(hb)
@@ -485,8 +513,9 @@ class Binder:
 
         if using:
             for name in using:
-                left_keys.append(bind_in_left(name))
-                right_keys.append(bind_in_right(name))
+                lk, rk = coerce_key_pair(bind_in_left(name), bind_in_right(name))
+                left_keys.append(lk)
+                right_keys.append(rk)
         elif ref.on is not None:
             n_left = len(lscope.entries)
             conjuncts = _split_conjuncts(self.bind_expr(ref.on, combined, None))
@@ -494,7 +523,7 @@ class Binder:
             for c in conjuncts:
                 lk_rk = _extract_equi_key(c, n_left)
                 if lk_rk is not None:
-                    lk, rk = lk_rk
+                    lk, rk = coerce_key_pair(*lk_rk)
                     left_keys.append(lk)
                     right_keys.append(rk)
                 else:
@@ -591,6 +620,8 @@ class Binder:
         sub, corr_l, corr_r = self._decorrelate(sub, plan.schema)
         key_r = E.Column(sub.schema.fields[0].name, index=0)
         key_r.dtype = sub.schema.fields[0].dtype
+        probe, key_r = coerce_key_pair(probe, key_r)
+        corr_l, corr_r = _coerce_key_lists(corr_l, corr_r)
         if not anti:
             j = L.Join(left=plan, right=sub, join_type=A.JoinType.SEMI,
                        left_keys=[probe] + corr_l, right_keys=[key_r] + corr_r)
@@ -621,6 +652,7 @@ class Binder:
     def _rewrite_exists(self, node: E.Exists, plan, scope, anti: bool):
         sub = self.bind_query(node.query, scope)
         sub, corr_l, corr_r = self._decorrelate(sub, plan.schema)
+        corr_l, corr_r = _coerce_key_lists(corr_l, corr_r)
         if not corr_l:
             # uncorrelated EXISTS: degenerate — keep all or no rows; model as
             # cross-semi on constant key
@@ -772,7 +804,16 @@ class Binder:
                         c = E.Column(agg_names[j], index=len(bound_groups) + j)
                         c.dtype = a.dtype
                         return c
-                raise PlanError("aggregate not collected (planner bug)")
+                # a late aggregate (ORDER BY over an aggregate not in the SELECT
+                # list): extend the Aggregate node in place
+                e.dtype = agg_result_type(e.func, e.arg.dtype if e.arg else None)
+                aggs.append(e)
+                agg_names.append(f"__agg_{len(aggs) - 1}")
+                node.schema = T.Schema(list(node.schema.fields) +
+                                       [T.Field(agg_names[-1], e.dtype, True)])
+                c = E.Column(agg_names[-1], index=len(node.schema) - 1)
+                c.dtype = e.dtype
+                return c
             n = copy.copy(e)
             if isinstance(n, E.Binary):
                 n.left = rewrite(n.left)
@@ -796,7 +837,7 @@ class Binder:
 
         new_proj = [rewrite(b) for b in bound_proj]
         new_having = rewrite(bound_having) if bound_having is not None else None
-        return node, new_proj, new_having, Scope.from_schema(node.schema)
+        return node, new_proj, new_having, Scope.from_schema(node.schema), rewrite
 
     def _filter(self, plan: L.LogicalPlan, pred: E.Expr) -> L.LogicalPlan:
         f = L.Filter(input=plan, predicate=pred)
@@ -1055,6 +1096,30 @@ def _or_all(parts: list[E.Expr]) -> E.Expr:
         n.dtype = T.BOOL
         out = n
     return out
+
+
+def _coerce_key_lists(lks: list[E.Expr], rks: list[E.Expr]):
+    pairs = [coerce_key_pair(lk, rk) for lk, rk in zip(lks, rks)]
+    return [p[0] for p in pairs], [p[1] for p in pairs]
+
+
+def coerce_key_pair(lk: E.Expr, rk: E.Expr) -> tuple[E.Expr, E.Expr]:
+    """Equi-join keys must produce IDENTICAL hash/equality lane structures on both
+    sides (exec/join.py builds 3 lanes for floats vs 1 for ints, and DATE32 days
+    vs TIMESTAMP micros differ in unit), so coerce both sides to their common
+    type — the same promotion _compile_numeric_binary applies to comparisons."""
+    a, b = lk.dtype, rk.dtype
+    if a == b or (a.is_string and b.is_string):
+        return lk, rk
+    ct = T.common_type(a, b)
+
+    def cast(e: E.Expr) -> E.Expr:
+        if e.dtype == ct:
+            return e
+        c = E.Cast(operand=e, to=ct)
+        c.dtype = ct
+        return c
+    return cast(lk), cast(rk)
 
 
 def _extract_equi_key(c: E.Expr, n_left: int):

@@ -22,7 +22,9 @@ from typing import Optional
 from igloo_tpu import types as T
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
-from igloo_tpu.plan.binder import _and_all, _split_conjuncts
+from igloo_tpu.plan.binder import (
+    _and_all, _extract_equi_key, _split_conjuncts, coerce_key_pair,
+)
 from igloo_tpu.sql.ast import JoinType
 
 
@@ -257,6 +259,36 @@ def _pushdown(plan: L.LogicalPlan, preds: list[E.Expr]) -> L.LogicalPlan:
     if isinstance(plan, L.Join):
         n_left = len(plan.left.schema)
         jt = plan.join_type
+        # Comma-list FROM items bind as CROSS joins with the WHERE equalities
+        # left as predicates. Materializing the cross product (|L|x|R| candidate
+        # slots) before filtering is catastrophic for the static-shape executor,
+        # so equality conjuncts spanning exactly both sides become join keys
+        # here, and any other both-sided conjunct becomes a residual (evaluated
+        # during candidate expansion, before the output batch is sized).
+        if jt in (JoinType.INNER, JoinType.CROSS):
+            remaining = []
+            for p in preds:
+                pair = None if _has_scalar_subquery(p) else \
+                    _extract_equi_key(p, n_left)
+                if pair is not None:
+                    lk, rk = coerce_key_pair(*pair)
+                    plan.left_keys.append(lk)
+                    plan.right_keys.append(rk)
+                    jt = plan.join_type = JoinType.INNER
+                else:
+                    remaining.append(p)
+            preds, remaining = remaining, []
+            for p in preds:
+                cols = _cols_of(p)
+                if cols and not _has_scalar_subquery(p) and \
+                        any(i < n_left for i in cols) and \
+                        any(i >= n_left for i in cols):
+                    plan.residual = _and_all(
+                        ([plan.residual] if plan.residual is not None else [])
+                        + [p])
+                else:
+                    remaining.append(p)
+            preds = remaining
         semi = jt in (JoinType.SEMI, JoinType.ANTI)
         n_out_left = n_left
         left_preds, right_preds, stuck = [], [], []

@@ -33,8 +33,9 @@ def lit(v, dtype):
 
 
 def run(expr, batch):
-    comp = ExprCompiler.for_batch(batch).compile(expr)
-    vals, nulls = comp.fn(Env.from_batch(batch))
+    compiler = ExprCompiler.for_batch(batch)
+    comp = compiler.compile(expr)
+    vals, nulls = comp.fn(Env.from_batch(batch, compiler.pool.device_args()))
     live = np.asarray(batch.live)
     v = np.asarray(vals)[live]
     n = np.asarray(nulls)[live] if nulls is not None else np.zeros(len(v), bool)
@@ -105,8 +106,9 @@ def test_capitalize_matches_reference_udf():
     b = B.from_arrow(t)
     e = E.Func("capitalize", [col("s", b, T.STRING)])
     e.dtype = T.STRING
-    comp = ExprCompiler.for_batch(b).compile(e)
-    vals, _ = comp.fn(Env.from_batch(b))
+    compiler = ExprCompiler.for_batch(b)
+    comp = compiler.compile(e)
+    vals, _ = comp.fn(Env.from_batch(b, compiler.pool.device_args()))
     ids = np.asarray(vals)[:3]
     out = [comp.out_dict.values[i] for i in ids]
     assert out == ["Hello", "World", ""]
@@ -157,8 +159,9 @@ def test_substr_and_length():
     b = B.from_arrow(t)
     e = E.Func("substr", [col("s", b, T.STRING), lit(1, T.INT64), lit(2, T.INT64)])
     e.dtype = T.STRING
-    comp = ExprCompiler.for_batch(b).compile(e)
-    vals, _ = comp.fn(Env.from_batch(b))
+    compiler = ExprCompiler.for_batch(b)
+    comp = compiler.compile(e)
+    vals, _ = comp.fn(Env.from_batch(b, compiler.pool.device_args()))
     ids = np.asarray(vals)[:2]
     assert [comp.out_dict.values[i] for i in ids] == ["he", "hi"]
     e2 = E.Func("length", [col("s", b, T.STRING)])
@@ -203,8 +206,9 @@ def test_coalesce_cross_dictionary_strings():
     b = B.from_arrow(t)
     e = E.Func("coalesce", [col("x", b, T.STRING), col("y", b, T.STRING)])
     e.dtype = T.STRING
-    comp = ExprCompiler.for_batch(b).compile(e)
-    vals, nulls = comp.fn(Env.from_batch(b))
+    compiler = ExprCompiler.for_batch(b)
+    comp = compiler.compile(e)
+    vals, nulls = comp.fn(Env.from_batch(b, compiler.pool.device_args()))
     ids = np.asarray(vals)[:2]
     assert [comp.out_dict.values[i] for i in ids] == ["aa", "zz"]
 

@@ -129,7 +129,8 @@ class TestAggregate:
 
 
 class TestJoin:
-    def _join(self, lt, rt, jt, n_keys=1, residual=None, out_names=None):
+    def _join(self, lt, rt, jt, n_keys=1, residual=None, out_names=None,
+              pool=None):
         lb, rb = from_arrow(lt), from_arrow(rt)
         lk = [col(lb, i) for i in range(n_keys)]
         rk = [col(rb, i) for i in range(n_keys)]
@@ -139,7 +140,8 @@ class TestJoin:
             fields = list(lb.schema.fields) + [
                 T.Field(f"r_{f.name}", f.dtype, True) for f in rb.schema.fields]
             schema = T.Schema(fields)
-        return to_arrow(join_batches(lb, rb, lk, rk, jt, residual, schema))
+        return to_arrow(join_batches(lb, rb, lk, rk, jt, residual, schema,
+                                     pool=pool))
 
     def test_inner_with_duplicates(self):
         lt = pa.table({"k": pa.array([1, 2, 2, 3], type=pa.int64()),
@@ -217,19 +219,22 @@ class TestJoin:
             o2.dtype = T.BOOL
             dicts = [c.dictionary for c in lb.columns] + \
                     [c.dictionary for c in rb.columns]
-            return ExprCompiler(dicts).compile(o2)
+            compiler = ExprCompiler(dicts)
+            return compiler.compile(o2), compiler.pool
 
         lt = pa.table({"k": pa.array([1, 2], type=pa.int64()),
                        "lv": pa.array([10, 20], type=pa.int64())})
         rt = pa.table({"k": pa.array([2, None], type=pa.int64())})
         lb, rb = from_arrow(lt), from_arrow(rt)
+        res, pool = not_in_residual(lb, rb)
         out = to_arrow(join_batches(lb, rb, [], [], JoinType.ANTI,
-                                    not_in_residual(lb, rb), lb.schema))
+                                    res, lb.schema, pool=pool))
         assert out.num_rows == 0
         rt2 = pa.table({"k": pa.array([2], type=pa.int64())})
         rb2 = from_arrow(rt2)
+        res2, pool2 = not_in_residual(lb, rb2)
         out2 = to_arrow(join_batches(lb, rb2, [], [], JoinType.ANTI,
-                                     not_in_residual(lb, rb2), lb.schema))
+                                     res2, lb.schema, pool=pool2))
         assert out2.column("lv").to_pylist() == [10]
 
     def test_string_keys_across_dictionaries(self):
@@ -273,9 +278,11 @@ class TestJoin:
         pred = Binary(op=BinOp.LT, left=lc, right=rc)
         pred.dtype = T.BOOL
         lb, rb = from_arrow(lt), from_arrow(rt)
-        comp = ExprCompiler([c.dictionary for c in lb.columns] +
-                            [c.dictionary for c in rb.columns]).compile(pred)
-        out = self._join(lt, rt, JoinType.INNER, residual=comp)
+        compiler = ExprCompiler([c.dictionary for c in lb.columns] +
+                                [c.dictionary for c in rb.columns])
+        comp = compiler.compile(pred)
+        out = self._join(lt, rt, JoinType.INNER, residual=comp,
+                         pool=compiler.pool)
         assert out.column("lv").to_pylist() == [5]
 
     def test_large_join_vs_pandas(self):

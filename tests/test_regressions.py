@@ -1,0 +1,112 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md) and the
+cross-join→equi-join optimizer rewrite (comma-FROM TPC-H shapes must not
+materialize cross products)."""
+import pyarrow as pa
+import pytest
+
+from igloo_tpu.engine import QueryEngine
+from igloo_tpu.plan import logical as L
+
+
+@pytest.fixture
+def eng():
+    e = QueryEngine()
+    e.register_table("t", pa.table({
+        "g": ["a", "a", "b"],
+        "x": [1, 1, 2],
+        "i": pa.array([1, 2, 3], type=pa.int64()),
+    }))
+    e.register_table("t3", pa.table({
+        "f": pa.array([1.0, 2.0, 9.0], type=pa.float64()),
+        "v": [10, 20, 90],
+    }))
+    return e
+
+
+def test_count_star_mixed_with_count_distinct(eng):
+    # ADVICE #1: COUNT(*) must count rows, not distinct combinations
+    t = eng.execute(
+        "SELECT g, COUNT(*) AS c, COUNT(DISTINCT x) AS d FROM t "
+        "GROUP BY g ORDER BY g")
+    assert t.column("c").to_pylist() == [2, 1]
+    assert t.column("d").to_pylist() == [1, 1]
+
+
+def test_join_key_type_coercion_int_float(eng):
+    # ADVICE #2: int-vs-float equi keys must coerce to a common type
+    t = eng.execute(
+        "SELECT v FROM t JOIN t3 ON t.i = t3.f ORDER BY v")
+    assert t.column("v").to_pylist() == [10, 20]
+
+
+def test_join_key_type_coercion_date_timestamp():
+    eng = QueryEngine()
+    eng.register_table("d1", pa.table({
+        "d": pa.array([0, 1], type=pa.int32()).cast(pa.date32()),
+        "a": [1, 2]}))
+    eng.register_table("d2", pa.table({
+        "ts": pa.array([86_400_000_000], type=pa.int64()).cast(
+            pa.timestamp("us")),
+        "b": [7]}))
+    t = eng.execute("SELECT a, b FROM d1 JOIN d2 ON d1.d = d2.ts")
+    assert t.column("a").to_pylist() == [2]
+    assert t.column("b").to_pylist() == [7]
+
+
+def test_cast_string_to_date(eng):
+    # ADVICE #3: CAST(string AS DATE) parses ISO dates instead of nulling out
+    t = eng.execute("SELECT CAST('1998-12-01' AS DATE) AS d FROM t LIMIT 1")
+    import datetime
+    assert t.column("d").to_pylist() == [datetime.date(1998, 12, 1)]
+
+
+def test_order_by_aggregate_expression(eng):
+    # ADVICE #4: ORDER BY COUNT(*) (not in the SELECT list by name)
+    t = eng.execute("SELECT g, COUNT(*) AS c FROM t GROUP BY g "
+                    "ORDER BY COUNT(*) DESC")
+    assert t.column("g").to_pylist() == ["a", "b"]
+    # ORDER BY an aggregate that is NOT in the SELECT list at all
+    t = eng.execute("SELECT g FROM t GROUP BY g ORDER BY SUM(i) DESC")
+    assert t.column("g").to_pylist() == ["a", "b"]
+
+
+def test_comma_join_becomes_equi_join(eng):
+    # optimizer rewrite: WHERE equality over comma-FROM becomes join keys
+    plan = eng.plan("SELECT v FROM t, t3 WHERE t.i = t3.f AND v > 5")
+    joins = [n for n in L.walk_plan(plan) if isinstance(n, L.Join)]
+    assert len(joins) == 1
+    assert len(joins[0].left_keys) == 1
+    from igloo_tpu.sql.ast import JoinType
+    assert joins[0].join_type is JoinType.INNER
+    t = eng.execute("SELECT v FROM t, t3 WHERE t.i = t3.f AND v > 5 ORDER BY v")
+    assert t.column("v").to_pylist() == [10, 20]
+
+
+def test_mixed_distinct_count_star_empty_input(eng):
+    # review finding: COUNT(*) must be 0, not NULL, over empty input
+    t = eng.execute("SELECT COUNT(*) AS c, COUNT(DISTINCT x) AS d FROM t "
+                    "WHERE x > 100")
+    assert t.column("c").to_pylist() == [0]
+    assert t.column("d").to_pylist() == [0]
+
+
+def test_cast_bad_date_entry_filtered_out():
+    # review finding: unparseable dictionary entries excluded by filters must
+    # not poison the query — they become NULL, not an error
+    eng = QueryEngine()
+    eng.register_table("u", pa.table({"s": ["2024-01-01", "n/a"]}))
+    t = eng.execute("SELECT CAST(s AS DATE) AS d FROM u WHERE s <> 'n/a'")
+    import datetime
+    assert t.column("d").to_pylist() == [datetime.date(2024, 1, 1)]
+    t2 = eng.execute("SELECT CAST(s AS DATE) AS d FROM u ORDER BY s")
+    assert t2.column("d").to_pylist() == [datetime.date(2024, 1, 1), None]
+
+
+def test_comma_join_non_equi_residual(eng):
+    # both-sided non-equality conjuncts become join residuals, not post-filters
+    plan = eng.plan("SELECT v FROM t, t3 WHERE t.i = t3.f AND t.i < t3.v")
+    joins = [n for n in L.walk_plan(plan) if isinstance(n, L.Join)]
+    assert joins[0].residual is not None
+    t = eng.execute("SELECT v FROM t, t3 WHERE t.i = t3.f AND t.i < t3.v "
+                    "ORDER BY v")
+    assert t.column("v").to_pylist() == [10, 20]
