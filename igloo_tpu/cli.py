@@ -67,7 +67,11 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.device == "cpu":
+        # env var alone is not enough under the axon tunnel (site setup
+        # overrides JAX_PLATFORMS); force via jax.config
         os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     elif args.device == "tpu":
         os.environ.setdefault("JAX_PLATFORMS", "tpu")
 

@@ -54,6 +54,10 @@ class CsvTable:
         except pa.ArrowInvalid as ex:
             raise ConnectorError(f"csv parse failed for {path}: {ex}") from None
 
+    def snapshot(self):
+        from igloo_tpu.connectors.parquet import file_snapshot
+        return file_snapshot(self._files)
+
     def schema(self) -> Schema:
         return self._schema
 

@@ -139,6 +139,23 @@ class IcebergTable:
 
     # --- provider protocol ---
 
+    def snapshot(self):
+        """Iceberg snapshot token: metadata file + data files (paths, mtimes,
+        sizes). A new table commit writes a new metadata version, changing the
+        token; read() re-resolves the file list so the fresh data is actually
+        served after invalidation."""
+        from igloo_tpu.connectors.parquet import file_snapshot
+        meta = self._metadata_file()
+        return file_snapshot(([meta] if meta else []) + self._files)
+
+    def _refresh(self) -> None:
+        """Re-resolve data files when the table's metadata version moved (a
+        commit happened after __init__); keeps read() consistent with
+        snapshot()-driven cache invalidation."""
+        files = self._resolve_data_files()
+        if files and files != self._files:
+            self._files = files
+
     def schema(self) -> Schema:
         return self._schema
 

@@ -40,6 +40,10 @@ class ParquetTable:
     def schema(self) -> Schema:
         return self._schema
 
+    def snapshot(self):
+        """Cache/CDC token: changes when any underlying file changes on disk."""
+        return file_snapshot(self._files)
+
     def num_partitions(self) -> int:
         return len(self._files)
 
@@ -64,6 +68,19 @@ class ParquetTable:
             raise
         except Exception as ex:
             raise ConnectorError(f"parquet read failed for {path}: {ex}") from None
+
+
+def file_snapshot(files: list[str]) -> tuple:
+    """(path, mtime_ns, size) per file — the cache/CDC invalidation token for
+    file-backed connectors (igloo_tpu/exec/cache.py, igloo_tpu/cdc.py)."""
+    out = []
+    for f in files:
+        try:
+            st = os.stat(f)
+            out.append((f, st.st_mtime_ns, st.st_size))
+        except OSError:
+            out.append((f, -1, -1))
+    return tuple(out)
 
 
 def _expand(path: str) -> list[str]:

@@ -52,11 +52,16 @@ class QueryResult:
 
 
 class QueryEngine:
-    def __init__(self, catalog: Optional[Catalog] = None, use_jit: bool = True):
+    def __init__(self, catalog: Optional[Catalog] = None, use_jit: bool = True,
+                 cache_budget_bytes: int = 1 << 30):
+        from igloo_tpu.exec.cache import BatchCache
         self.catalog = catalog if catalog is not None else Catalog()
         self.udfs: dict[str, UdfDef] = {}
         self._jit_cache: dict = {}
         self._use_jit = use_jit
+        # HBM batch cache: scan results stay device-resident across queries
+        # (the real version of the reference's unenforced CacheConfig, gap G7)
+        self.batch_cache = BatchCache(cache_budget_bytes)
         # reference parity: capitalize registered at construction (lib.rs:41-42)
         self.register_udf(UdfDef("capitalize", T.STRING))
 
@@ -66,9 +71,13 @@ class QueryEngine:
         if isinstance(provider, pa.Table):
             provider = MemTable(provider)
         self.catalog.register(name, provider)
+        # a replaced provider's id() can be reused by the allocator, so identity
+        # tokens alone cannot be trusted across re-registration — evict eagerly
+        self.batch_cache.invalidate_table(name.lower())
 
     def deregister_table(self, name: str) -> None:
         self.catalog.deregister(name)
+        self.batch_cache.invalidate_table(name.lower())
 
     def register_udf(self, udf: UdfDef) -> None:
         self.udfs[udf.name.lower()] = udf
@@ -107,7 +116,7 @@ class QueryEngine:
             plan = optimize(bound)
             text = L.plan_tree_str(plan)
             if stmt.analyze:
-                ex = Executor(self._jit_cache, use_jit=self._use_jit)
+                ex = self._executor()
                 t1 = time.perf_counter()
                 ex.execute_to_arrow(plan)
                 text += f"\n-- execution: {time.perf_counter() - t1:.4f}s"
@@ -130,11 +139,15 @@ class QueryEngine:
                                elapsed_s=time.perf_counter() - t0)
         raise IglooError(f"unsupported statement {type(stmt).__name__}")
 
+    def _executor(self) -> Executor:
+        return Executor(self._jit_cache, use_jit=self._use_jit,
+                        batch_cache=self.batch_cache)
+
     def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
         with span("bind+optimize"):
             bound = Binder(self.catalog, udfs=self.udfs).bind(stmt)
             plan = optimize(bound)
-        ex = Executor(self._jit_cache, use_jit=self._use_jit)
+        ex = self._executor()
         with span("execute"):
             table = ex.execute_to_arrow(plan)
         if want_plan:

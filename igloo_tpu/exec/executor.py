@@ -42,6 +42,7 @@ from igloo_tpu.exec.sort_limit import limit_batch, sort_batch
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
+from igloo_tpu.utils import tracing
 
 _SHRINK_FACTOR = 4  # shrink a batch when capacity > factor * needed
 
@@ -79,10 +80,12 @@ def attach_dicts(batch: DeviceBatch, dicts) -> DeviceBatch:
 
 
 class Executor:
-    def __init__(self, jit_cache: Optional[dict] = None, use_jit: bool = True):
+    def __init__(self, jit_cache: Optional[dict] = None, use_jit: bool = True,
+                 batch_cache=None):
         # shared across queries when the engine passes its own cache dict
         self._cache = jit_cache if jit_cache is not None else {}
         self._use_jit = use_jit
+        self._batch_cache = batch_cache  # Optional[BatchCache]
 
     # --- cache helpers ---
 
@@ -91,10 +94,13 @@ class Executor:
         key = (kind, fingerprint)
         fn = self._cache.get(key)
         if fn is None:
+            tracing.counter("jit.miss")
             fn = build()
             if self._use_jit:
                 fn = jax.jit(fn, static_argnums=static_argnums)
             self._cache[key] = fn
+        else:
+            tracing.counter("jit.hit")
         return fn
 
     # --- entry ---
@@ -118,11 +124,24 @@ class Executor:
     # --- leaves ---
 
     def _exec_scan(self, plan: L.Scan) -> DeviceBatch:
+        key = snap = None
+        if self._batch_cache is not None:
+            from igloo_tpu.exec.cache import provider_snapshot
+            key = (plan.table,
+                   tuple(plan.projection) if plan.projection is not None else None,
+                   expr_fingerprint(plan.pushed_filters))
+            snap = provider_snapshot(plan.provider)
+            hit = self._batch_cache.get(key, snap)
+            if hit is not None:
+                return hit
         table = plan.provider.read(projection=plan.projection,
                                    filters=plan.pushed_filters)
         if plan.projection is not None:
             table = table.select(plan.projection)
-        return from_arrow(table, schema=plan.schema)
+        batch = from_arrow(table, schema=plan.schema)
+        if self._batch_cache is not None:
+            self._batch_cache.put(key, batch, snap)
+        return batch
 
     def _exec_values(self, plan: L.Values) -> DeviceBatch:
         n = len(plan.rows)

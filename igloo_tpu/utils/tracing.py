@@ -1,9 +1,11 @@
-"""Tracing / timing spans.
+"""Tracing / timing spans + process-wide counters.
 
 The reference only has `tracing` calls in its cache crate with no subscriber ever
 installed (SURVEY.md §5.1); here spans are real: nested timers recorded into a
 thread-local trace that callers (CLI --explain-timing, coordinator per-fragment
-metrics, bench harness) can read. Integrates with `jax.profiler` when enabled.
+metrics, bench harness) can read. Counters track cross-query events (compile
+cache hits/misses, batch cache hits/evictions). `profile_trace()` wraps
+`jax.profiler.trace` for device-level profiles.
 """
 from __future__ import annotations
 
@@ -11,11 +13,39 @@ import contextlib
 import logging
 import threading
 import time
+from collections import Counter
 from dataclasses import dataclass, field
 
 log = logging.getLogger("igloo_tpu")
 
 _tls = threading.local()
+
+_counters: Counter = Counter()
+_counters_lock = threading.Lock()
+
+
+def counter(name: str, delta: int = 1) -> None:
+    """Bump a process-wide counter (thread-safe)."""
+    with _counters_lock:
+        _counters[name] += delta
+
+
+def counters() -> dict:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a jax.profiler trace (TensorBoard format) around a block."""
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield
 
 
 @dataclass

@@ -1,16 +1,23 @@
 """Test configuration: run the whole suite on a virtual 8-device CPU mesh so
 sharding/shuffle paths execute in CI without TPUs (SURVEY.md §4 test strategy (b);
-the reference has no distributed tests at all — we invent the strategy here)."""
+the reference has no distributed tests at all — we invent the strategy here).
+
+NOTE: under the axon TPU tunnel, `JAX_PLATFORMS=cpu` in the environment is
+overridden by the site setup (JAX_PLATFORMS=axon + /root/.axon_site), so the
+platform MUST be forced via jax.config.update after import — env vars alone
+silently leave the suite running on the remote TPU (where every host fetch
+pays a ~78ms tunnel roundtrip)."""
 import os
 
-# force CPU even when the ambient environment points JAX at a TPU: the suite
-# simulates an 8-chip mesh and must not eat real-chip compile latency
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+assert jax.default_backend() == "cpu", (
+    "test suite must run on the virtual CPU mesh, got "
+    f"{jax.default_backend()}")
