@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Benchmark harness: TPC-H on the igloo_tpu engine vs a pandas CPU baseline.
+
+Run: `python bench.py` (the round driver captures stdout).
+
+Prints per-query detail lines to stderr and EXACTLY ONE JSON line to stdout:
+
+    {"metric": "tpch_warm_rows_per_s", "value": N, "unit": "rows/s/chip",
+     "vs_baseline": R, "detail": {...}}
+
+where `value` is the geometric-mean warm throughput over the benchmark query
+set (rows of the dominant scanned table / warm wall-clock) on the default JAX
+device (one TPU chip under the driver), and `vs_baseline` is the ratio of that
+throughput to single-threaded pandas executing the same queries over the same
+in-memory data (>1.0 = faster than the pandas CPU baseline).
+
+The reference publishes no numbers (BASELINE.md: roadmap TODO only), so the
+baseline is measured here, per BASELINE.md's "measured, not copied" plan.
+
+Env knobs: BENCH_SF (default 0.1), BENCH_QUERIES (csv, default q1,q3,q5,q6),
+BENCH_WARM_RUNS (default 3).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# pandas baselines: the same four queries, idiomatic single-threaded pandas.
+# These play the role of the reference's working CPU path (DataFusion via
+# QueryEngine::execute, crates/engine/src/lib.rs:54-57) — a single-node CPU
+# engine executing the identical query over the identical data.
+# ---------------------------------------------------------------------------
+
+def _pd_q1(t):
+    import datetime as _dt
+    cut = (_dt.date(1998, 12, 1) - _dt.date(1970, 1, 1)).days - 90
+    li = t["lineitem"]
+    d = li[li["l_shipdate"] <= cut]
+    g = d.assign(
+        disc_price=d.l_extendedprice * (1 - d.l_discount),
+        charge=d.l_extendedprice * (1 - d.l_discount) * (1 + d.l_tax),
+    ).groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
+        sum_qty=("l_quantity", "sum"), sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"), sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"), avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"), count_order=("l_quantity", "size"),
+    )
+    return g.sort_values(["l_returnflag", "l_linestatus"])
+
+
+def _pd_q3(t):
+    import datetime as _dt
+    cut = (_dt.date(1995, 3, 15) - _dt.date(1970, 1, 1)).days
+    c = t["customer"]; o = t["orders"]; li = t["lineitem"]
+    c = c[c.c_mktsegment == "BUILDING"][["c_custkey"]]
+    o = o[o.o_orderdate < cut][["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]]
+    li = li[li.l_shipdate > cut][["l_orderkey", "l_extendedprice", "l_discount"]]
+    j = li.merge(o, left_on="l_orderkey", right_on="o_orderkey").merge(
+        c, left_on="o_custkey", right_on="c_custkey")
+    j = j.assign(rev=j.l_extendedprice * (1 - j.l_discount))
+    g = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"], as_index=False).rev.sum()
+    return g.sort_values(["rev", "o_orderdate"], ascending=[False, True]).head(10)
+
+
+def _pd_q5(t):
+    import datetime as _dt
+    lo = (_dt.date(1994, 1, 1) - _dt.date(1970, 1, 1)).days
+    hi = (_dt.date(1995, 1, 1) - _dt.date(1970, 1, 1)).days
+    r = t["region"]; n = t["nation"]; s = t["supplier"]; c = t["customer"]
+    o = t["orders"]; li = t["lineitem"]
+    r = r[r.r_name == "ASIA"][["r_regionkey"]]
+    n = n.merge(r, left_on="n_regionkey", right_on="r_regionkey")
+    o = o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)]
+    j = (li.merge(o[["o_orderkey", "o_custkey"]], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(s[["s_suppkey", "s_nationkey"]], left_on="l_suppkey", right_on="s_suppkey")
+         .merge(c[["c_custkey", "c_nationkey"]], left_on="o_custkey", right_on="c_custkey"))
+    j = j[j.c_nationkey == j.s_nationkey]
+    j = j.merge(n[["n_nationkey", "n_name"]], left_on="s_nationkey", right_on="n_nationkey")
+    j = j.assign(rev=j.l_extendedprice * (1 - j.l_discount))
+    return j.groupby("n_name", as_index=False).rev.sum().sort_values("rev", ascending=False)
+
+
+def _pd_q6(t):
+    import datetime as _dt
+    lo = (_dt.date(1994, 1, 1) - _dt.date(1970, 1, 1)).days
+    hi = (_dt.date(1995, 1, 1) - _dt.date(1970, 1, 1)).days
+    li = t["lineitem"]
+    d = li[(li.l_shipdate >= lo) & (li.l_shipdate < hi)
+           & (li.l_discount >= 0.05) & (li.l_discount <= 0.07)
+           & (li.l_quantity < 24)]
+    return float((d.l_extendedprice * d.l_discount).sum())
+
+
+_PD = {"q1": _pd_q1, "q3": _pd_q3, "q5": _pd_q5, "q6": _pd_q6}
+
+
+def _to_pandas(tables):
+    out = {}
+    for name, tbl in tables.items():
+        df = tbl.to_pandas()
+        for col in df.columns:
+            if df[col].dtype == object and col.endswith("date"):
+                pass
+        # date32 -> int days since epoch for cheap comparisons
+        import pandas as _pd
+        for col in df.columns:
+            if _pd.api.types.is_object_dtype(df[col]) and len(df) and hasattr(df[col].iloc[0], "toordinal"):
+                import datetime as _dt
+                epoch = _dt.date(1970, 1, 1).toordinal()
+                df[col] = df[col].map(lambda v: v.toordinal() - epoch)
+        out[name] = df
+    return out
+
+
+def _time(fn, runs: int):
+    best = math.inf
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    queries = os.environ.get("BENCH_QUERIES", "q1,q3,q5,q6").split(",")
+    warm_runs = int(os.environ.get("BENCH_WARM_RUNS", "3"))
+
+    import jax
+    log(f"device: {jax.devices()[0]} backend={jax.default_backend()}")
+
+    from igloo_tpu.bench.tpch import QUERIES, gen_tables, register_all
+    from igloo_tpu.engine import QueryEngine
+
+    t0 = time.perf_counter()
+    tables = gen_tables(sf=sf)
+    n_li = tables["lineitem"].num_rows
+    log(f"generated TPC-H sf={sf}: lineitem={n_li} rows "
+        f"({time.perf_counter() - t0:.1f}s)")
+
+    engine = QueryEngine()
+    register_all(engine, tables)
+
+    pdt = _to_pandas(tables)
+
+    detail = {"sf": sf, "lineitem_rows": n_li, "queries": {}}
+    ours_tp, base_tp = [], []
+    for q in queries:
+        sql = QUERIES[q]
+        t0 = time.perf_counter()
+        engine.execute(sql)
+        cold = time.perf_counter() - t0
+        warm = _time(lambda: engine.execute(sql), warm_runs)
+        rps = n_li / warm
+        rec = {"cold_s": round(cold, 4), "warm_s": round(warm, 4),
+               "rows_per_s": round(rps)}
+        if q in _PD:
+            pd_s = _time(lambda: _PD[q](pdt), max(warm_runs, 3))
+            rec["pandas_s"] = round(pd_s, 4)
+            rec["vs_pandas"] = round(pd_s / warm, 3)
+            base_tp.append(n_li / pd_s)
+            ours_tp.append(rps)
+        detail["queries"][q] = rec
+        log(f"{q}: cold={cold:.3f}s warm={warm:.4f}s "
+            f"({rps:,.0f} rows/s) pandas={rec.get('pandas_s', '-')}s "
+            f"vs_pandas={rec.get('vs_pandas', '-')}")
+
+    gmean_ours = math.exp(sum(math.log(x) for x in ours_tp) / len(ours_tp))
+    gmean_base = math.exp(sum(math.log(x) for x in base_tp) / len(base_tp))
+    result = {
+        "metric": "tpch_warm_rows_per_s",
+        "value": round(gmean_ours),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(gmean_ours / gmean_base, 4),
+        "detail": detail,
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()
