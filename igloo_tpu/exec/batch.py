@@ -345,13 +345,27 @@ def from_arrow(
 
 def to_arrow(batch: DeviceBatch) -> pa.Table:
     """DeviceBatch -> pyarrow Table on host, dropping dead lanes, decoding dictionaries,
-    re-applying null masks. Order of surviving rows is preserved."""
-    live = np.asarray(batch.live)
-    idx = np.nonzero(live)[0]
+    re-applying null masks. Order of surviving rows is preserved.
+
+    All device buffers are fetched in ONE `jax.device_get` call: it issues every
+    per-array copy_to_host_async before blocking, so the host pays one device
+    roundtrip instead of one per column — on a tunneled TPU a roundtrip is
+    ~100ms, so per-column fetches dominated warm query time (round-2 weak #1)."""
+    host_live, host_vals, host_nulls = jax.device_get(
+        (batch.live, [c.values for c in batch.columns],
+         [c.nulls for c in batch.columns]))
+    return arrow_from_host(batch, host_live, host_vals, host_nulls)
+
+
+def arrow_from_host(batch: DeviceBatch, host_live, host_vals, host_nulls) -> pa.Table:
+    """Build the pyarrow Table from already-fetched host copies of a batch's
+    device buffers (see `to_arrow`; the executor also calls this directly after
+    a speculative compact-and-fetch)."""
+    idx = np.nonzero(host_live)[0]
     arrays, fields = [], []
-    for f, c in zip(batch.schema, batch.columns):
-        vals = np.asarray(c.values)[idx]
-        nulls = np.asarray(c.nulls)[idx] if c.nulls is not None else None
+    for f, c, hv, hn in zip(batch.schema, batch.columns, host_vals, host_nulls):
+        vals = hv[idx]
+        nulls = hn[idx] if hn is not None else None
         if f.dtype.is_string:
             d = c.dictionary.values if c.dictionary is not None and len(c.dictionary) else np.asarray([], dtype=object)
             if len(d):

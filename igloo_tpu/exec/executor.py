@@ -80,12 +80,25 @@ def attach_dicts(batch: DeviceBatch, dicts) -> DeviceBatch:
 
 
 class Executor:
+    # Speculative join expand: when both inputs fit the budget, expand with
+    # capacity max(left, right) WITHOUT syncing on the exact candidate total.
+    # That bound is exact for FK joins (every TPC-H join: one side's keys are
+    # unique, so total <= max live side); overflow (a genuine many-to-many
+    # blowup) only DROPS candidates past the cap — expand masks by the true
+    # total — so the deferred device-side `total > cap` flags checked at the
+    # final fetch make the fallback (exact re-execution, one sync per join)
+    # fully correct. Saves one ~100ms device roundtrip per join on a tunneled
+    # TPU (round-2 weak #1: warm Q5 spent 5 of its 7 roundtrips here).
+    _SPECULATIVE_JOIN_BUDGET = 1 << 22
+
     def __init__(self, jit_cache: Optional[dict] = None, use_jit: bool = True,
-                 batch_cache=None):
+                 batch_cache=None, speculate: bool = True):
         # shared across queries when the engine passes its own cache dict
         self._cache = jit_cache if jit_cache is not None else {}
         self._use_jit = use_jit
         self._batch_cache = batch_cache  # Optional[BatchCache]
+        self._speculate = speculate
+        self._deferred_overflow: list = []  # device bools, checked at final fetch
 
     # --- cache helpers ---
 
@@ -106,10 +119,68 @@ class Executor:
     # --- entry ---
 
     def execute(self, plan: L.LogicalPlan) -> DeviceBatch:
-        return self._exec(plan)
+        batch = self._exec(plan)
+        if self._deferred_overflow:
+            flags = jax.device_get(self._deferred_overflow)
+            self._deferred_overflow = []
+            if any(bool(f) for f in flags):
+                return self._exact_copy().execute(plan)
+        return batch
+
+    def _exact_copy(self) -> "Executor":
+        """A sibling executor with speculation off (shares all caches); used to
+        re-run a plan after a deferred speculative-join overflow fired."""
+        tracing.counter("join.speculation_overflow")
+        return Executor(self._cache, use_jit=self._use_jit,
+                        batch_cache=self._batch_cache, speculate=False)
+
+    # Above this capacity a final batch is speculatively compacted down to this
+    # many lanes before the device->host fetch: most query results fit, so the
+    # common case pays ONE roundtrip carrying (count, compacted lanes) instead
+    # of either a huge padded transfer or a count sync followed by a fetch.
+    # On overflow (count > cap) we pay the exact compact + refetch.
+    _FINAL_FETCH_CAPACITY = 1 << 10
 
     def execute_to_arrow(self, plan: L.LogicalPlan) -> pa.Table:
-        return to_arrow(self._exec(plan))
+        from igloo_tpu.exec.batch import arrow_from_host
+        batch = self._exec(plan)
+        deferred, self._deferred_overflow = self._deferred_overflow, []
+        cap = self._FINAL_FETCH_CAPACITY
+        if batch.capacity <= cap:
+            flags, host_live, host_vals, host_nulls = jax.device_get(
+                (deferred, batch.live, [c.values for c in batch.columns],
+                 [c.nulls for c in batch.columns]))
+            if any(bool(f) for f in flags):
+                return self._exact_copy().execute_to_arrow(plan)
+            return arrow_from_host(batch, host_live, host_vals, host_nulls)
+        fp = ("spec_compact", batch_proto_key(batch), cap)
+
+        def build():
+            def fn(b):
+                n = jnp.sum(b.live)
+                return K.resize_batch(
+                    K.apply_perm(b, K.compact_perm(b.live)), cap), n
+            return fn
+        spec, n_dev = self._jitted("spec_compact", fp, build)(strip_dicts(batch))
+        spec = attach_dicts(spec, [c.dictionary for c in batch.columns])
+        flags, host_n, host_live, host_vals, host_nulls = jax.device_get(
+            (deferred, n_dev, spec.live, [c.values for c in spec.columns],
+             [c.nulls for c in spec.columns]))
+        if any(bool(f) for f in flags):
+            return self._exact_copy().execute_to_arrow(plan)
+        if int(host_n) <= cap:
+            return arrow_from_host(spec, host_live, host_vals, host_nulls)
+        # overflow: compact to the exact capacity and refetch
+        want = round_capacity(int(host_n))
+        fp = ("compact", batch_proto_key(batch), want)
+
+        def build_full():
+            def fn(b):
+                return K.resize_batch(
+                    K.apply_perm(b, K.compact_perm(b.live)), want)
+            return fn
+        out = self._jitted("compact", fp, build_full)(strip_dicts(batch))
+        return to_arrow(attach_dicts(out, [c.dictionary for c in batch.columns]))
 
     def _exec(self, plan: L.LogicalPlan) -> DeviceBatch:
         m = getattr(self, "_exec_" + type(plan).__name__.lower(), None)
@@ -353,14 +424,29 @@ class Executor:
         ls, rs = strip_dicts(left), strip_dicts(right)
         consts = pool.device_args()
         p = probe(ls, rs, consts)
-        total = int(p.total)  # the one host sync
-        out = expand(ls, rs, p, choose_match_capacity(total), consts)
+        spec_cap = round_capacity(max(left.capacity, right.capacity))
+        if (self._speculate and jt is not JoinType.CROSS
+                and spec_cap <= self._SPECULATIVE_JOIN_BUDGET):
+            total = None
+            match_cap = spec_cap
+            self._deferred_overflow.append(p.total > match_cap)
+        else:
+            total = int(p.total)  # the one host sync
+            match_cap = choose_match_capacity(total)
+        out = expand(ls, rs, p, match_cap, consts)
         if jt in (JoinType.SEMI, JoinType.ANTI):
             dicts = [c.dictionary for c in left.columns]
         else:
             dicts = [c.dictionary for c in left.columns] + \
                 [c.dictionary for c in right.columns]
         out = attach_dicts(out, dicts[: len(out.columns)])
+        if total is None:
+            # speculative path: carrying padded lanes beats a count sync
+            return out
+        if jt in (JoinType.INNER, JoinType.CROSS):
+            # live rows <= total (residual can only reduce), so the already-
+            # synced candidate count bounds the shrink without a second sync
+            return self._maybe_shrink(out, known_live=total)
         return self._maybe_shrink(out)
 
     def _exec_sort(self, plan: L.Sort) -> DeviceBatch:
@@ -389,7 +475,9 @@ class Executor:
             return fn
         out = self._jitted("limit", fp, build)(strip_dicts(batch))
         out = attach_dicts(out, [c.dictionary for c in batch.columns])
-        return self._maybe_shrink(out)
+        # LIMIT bounds the live count statically — no sync needed
+        known = plan.limit if plan.limit is not None else None
+        return self._maybe_shrink(out, known_live=known)
 
     def _exec_union(self, plan: L.Union) -> DeviceBatch:
         batches = [self._exec(ch) for ch in plan.inputs]
@@ -434,7 +522,13 @@ class Executor:
         return E.transform(e, sub)
 
     def _eval_scalar(self, plan: L.LogicalPlan):
-        t = self.execute_to_arrow(plan)
+        # scope the deferred speculative-overflow flags to the subquery: its
+        # final fetch must not consume (and mask) the outer query's flags
+        saved, self._deferred_overflow = self._deferred_overflow, []
+        try:
+            t = self.execute_to_arrow(plan)
+        finally:
+            self._deferred_overflow = saved + self._deferred_overflow
         if t.num_rows > 1:
             raise ExecError("scalar subquery returned more than one row")
         dtype = plan.schema.fields[0].dtype
@@ -451,8 +545,16 @@ class Executor:
 
     # --- capacity management (shape bucketing between stages) ---
 
-    def _maybe_shrink(self, batch: DeviceBatch) -> DeviceBatch:
-        n = batch.num_live()  # host sync
+    # Below this capacity a batch is cheap enough to carry oversized: skipping
+    # the shrink avoids a num_live() device->host sync (~100ms on a tunneled
+    # TPU), which dominated warm query time (round-2 weak #1).
+    _SYNC_FREE_CAPACITY = 1 << 16
+
+    def _maybe_shrink(self, batch: DeviceBatch,
+                      known_live: Optional[int] = None) -> DeviceBatch:
+        if known_live is None and batch.capacity <= self._SYNC_FREE_CAPACITY:
+            return batch
+        n = batch.num_live() if known_live is None else known_live  # host sync
         want = round_capacity(max(n, 1))
         if batch.capacity > _SHRINK_FACTOR * want:
             fp = ("compact", batch_proto_key(batch), want)

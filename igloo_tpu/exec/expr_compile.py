@@ -59,31 +59,70 @@ class ConstPool:
     def __init__(self):
         self.arrays: list[np.ndarray] = []
 
+    # pad memo keyed on the SOURCE array's id (e.g. DictInfo.hashes, which is
+    # stable for a table's lifetime): repeated queries re-adding the same host
+    # array get the identical padded array object back, which is what makes the
+    # device-upload memo below actually hit across executions.
+    _PAD_MEMO: dict = {}
+    _PAD_MEMO_MAX = 512
+
+    @classmethod
+    def _padded(cls, arr: np.ndarray) -> np.ndarray:
+        key = id(arr)
+        ent = cls._PAD_MEMO.get(key)
+        if ent is not None and ent[0] is arr:
+            return ent[1]
+        out = np.ascontiguousarray(arr)
+        from igloo_tpu.exec.batch import round_capacity
+        if out.ndim == 1:
+            cap = round_capacity(max(out.shape[0], 1))
+            if cap != out.shape[0]:
+                padded = np.zeros((cap,), dtype=out.dtype)
+                padded[: out.shape[0]] = out
+                out = padded
+        elif out.ndim == 2:
+            c0 = round_capacity(max(out.shape[0], 1))
+            c1 = round_capacity(max(out.shape[1], 1))
+            if (c0, c1) != out.shape:
+                padded = np.zeros((c0, c1), dtype=out.dtype)
+                padded[: out.shape[0], : out.shape[1]] = out
+                out = padded
+        if len(cls._PAD_MEMO) >= cls._PAD_MEMO_MAX:
+            for k in list(cls._PAD_MEMO)[: cls._PAD_MEMO_MAX // 2]:
+                del cls._PAD_MEMO[k]
+        cls._PAD_MEMO[key] = (arr, out)
+        return out
+
     def add(self, arr: np.ndarray) -> int:
-        arr = np.ascontiguousarray(arr)
-        if arr.ndim == 1:
-            from igloo_tpu.exec.batch import round_capacity
-            cap = round_capacity(max(arr.shape[0], 1))
-            if cap != arr.shape[0]:
-                out = np.zeros((cap,), dtype=arr.dtype)
-                out[: arr.shape[0]] = arr
-                arr = out
-        elif arr.ndim == 2:
-            from igloo_tpu.exec.batch import round_capacity
-            c0 = round_capacity(max(arr.shape[0], 1))
-            c1 = round_capacity(max(arr.shape[1], 1))
-            if (c0, c1) != arr.shape:
-                out = np.zeros((c0, c1), dtype=arr.dtype)
-                out[: arr.shape[0], : arr.shape[1]] = arr
-                arr = out
-        self.arrays.append(arr)
+        self.arrays.append(self._padded(arr))
         return len(self.arrays) - 1
 
     def signature(self) -> tuple:
         return tuple((a.shape, str(a.dtype)) for a in self.arrays)
 
+    # process-wide host-array -> device-array memo: repeated executions reuse
+    # HBM-resident const buffers (dictionary hash lanes, LUTs) instead of
+    # re-uploading per query (round-2 advisor finding). Keyed on id() with the
+    # host array kept alive by the value tuple, so an id can't be recycled
+    # while its entry is live; bounded FIFO eviction keeps it from growing
+    # without bound when dictionaries churn.
+    _DEVICE_MEMO: dict = {}
+    _DEVICE_MEMO_MAX = 512
+
+    @classmethod
+    def _to_device(cls, a: np.ndarray):
+        ent = cls._DEVICE_MEMO.get(id(a))
+        if ent is not None and ent[0] is a:
+            return ent[1]
+        dev = jnp.asarray(a)
+        if len(cls._DEVICE_MEMO) >= cls._DEVICE_MEMO_MAX:
+            for k in list(cls._DEVICE_MEMO)[: cls._DEVICE_MEMO_MAX // 2]:
+                del cls._DEVICE_MEMO[k]
+        cls._DEVICE_MEMO[id(a)] = (a, dev)
+        return dev
+
     def device_args(self) -> tuple:
-        return tuple(jnp.asarray(a) for a in self.arrays)
+        return tuple(self._to_device(a) for a in self.arrays)
 
 
 @dataclass
