@@ -1,0 +1,481 @@
+"""ShardedExecutor: the multi-chip execution tier.
+
+Extends the single-device Executor so that scans produce row-sharded
+DeviceBatches over a `jax.sharding.Mesh`, and the blocking operators become
+mesh programs:
+
+- **Aggregate** = local partial aggregation -> `all_to_all` shuffle of the
+  partial rows by group-key hash -> local final aggregation, all inside ONE
+  `shard_map`-traced jit stage. Output stays row-sharded; a global (no-keys)
+  aggregate all-gathers the one-row partials instead. AVG splits into
+  SUM+COUNT partials recombined in the final stage.
+- **Join** = co-partition both sides by key hash (`all_to_all`) -> local
+  sorted-probe join per device, one `shard_map` stage. The expand capacity is
+  speculative (exact for FK joins) with device-side overflow flags deferred
+  to the final fetch, like the single-device speculative join.
+- Pipeline operators (filter/project) are inherited unchanged: they are
+  elementwise over lanes, so XLA propagates the row sharding through the same
+  jitted stages with zero collectives.
+- Sort / distinct / set ops / union gather to replicated lanes and delegate
+  to the single-device kernels (they run on post-aggregation row counts).
+
+This is the TPU-native replacement for the reference's unimplemented
+distributed execution (serialize_plan returns empty bytes and results are
+faked, crates/coordinator/src/distributed_executor.rs:203-222; the shuffle
+RPC returns empty, crates/worker/src/service.rs:26-32): rows move over ICI
+collectives inside compiled programs instead of over coordinator round-trips.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from igloo_tpu import types as T
+from igloo_tpu.exec import kernels as K
+from igloo_tpu.exec.aggregate import AggSpec, aggregate_batch
+from igloo_tpu.exec.batch import (
+    DeviceBatch, DeviceColumn, from_arrow, round_capacity,
+)
+from igloo_tpu.exec.executor import (
+    Executor, attach_dicts, batch_proto_key, expr_fingerprint, strip_dicts,
+)
+from igloo_tpu.exec.expr_compile import Compiled, ConstPool, ExprCompiler
+from igloo_tpu.exec.join import expand_phase, make_key_hash_idxs, probe_phase
+from igloo_tpu.parallel.mesh import (
+    ROWS, is_row_sharded, make_mesh, replicate, shard_rows,
+)
+from igloo_tpu.parallel.shuffle import (
+    default_bucket_cap, hash_to_dest, shuffle_batch_local,
+)
+from igloo_tpu.plan import expr as E
+from igloo_tpu.plan import logical as L
+from igloo_tpu.sql.ast import JoinType
+from igloo_tpu.utils import tracing
+
+
+def _col_ref(i: int, dtype: T.DataType, out_dict=None) -> Compiled:
+    return Compiled(lambda env, _i=i: (env.values[_i], env.nulls[_i]),
+                    dtype, out_dict)
+
+
+# Per-aggregate partial/final decomposition: partial runs on each shard's
+# rows, final runs after the partials are co-located by group-key hash.
+# (func, partial specs builder, final spec builder over partial col indices)
+_ASSOCIATIVE = {E.AggFunc.SUM: E.AggFunc.SUM, E.AggFunc.MIN: E.AggFunc.MIN,
+                E.AggFunc.MAX: E.AggFunc.MAX}
+
+
+class ShardedExecutor(Executor):
+    """Executor whose blocking operators run as mesh programs (see module doc)."""
+
+    def __init__(self, jit_cache: Optional[dict] = None, use_jit: bool = True,
+                 batch_cache=None, speculate: bool = True,
+                 mesh: Optional[Mesh] = None):
+        super().__init__(jit_cache, use_jit=use_jit, batch_cache=batch_cache,
+                         speculate=speculate)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_dev = int(self.mesh.devices.size)
+
+    # --- plumbing overrides ---
+
+    def _exact_copy(self) -> "ShardedExecutor":
+        tracing.counter("join.speculation_overflow")
+        return ShardedExecutor(self._cache, use_jit=self._use_jit,
+                               batch_cache=self._batch_cache, speculate=False,
+                               mesh=self.mesh)
+
+    def _exec_scan(self, plan: L.Scan) -> DeviceBatch:
+        key = snap = None
+        if self._batch_cache is not None:
+            from igloo_tpu.exec.cache import provider_snapshot
+            key = ("sharded", self.n_dev, plan.table,
+                   tuple(plan.projection) if plan.projection is not None else None,
+                   expr_fingerprint(plan.pushed_filters))
+            snap = provider_snapshot(plan.provider)
+            hit = self._batch_cache.get(key, snap)
+            if hit is not None:
+                return hit
+        table = plan.provider.read(projection=plan.projection,
+                                   filters=plan.pushed_filters)
+        if plan.projection is not None:
+            table = table.select(plan.projection)
+        batch = shard_rows(from_arrow(table, schema=plan.schema), self.mesh)
+        if self._batch_cache is not None:
+            self._batch_cache.put(key, batch, snap)
+        return batch
+
+    def _exec_values(self, plan: L.Values) -> DeviceBatch:
+        return shard_rows(super()._exec_values(plan), self.mesh)
+
+    def _maybe_shrink(self, batch: DeviceBatch,
+                      known_live: Optional[int] = None) -> DeviceBatch:
+        # row-sharded batches keep their (speculatively bounded) capacity:
+        # compacting across shards is another shuffle, and the sharded join /
+        # aggregate already bound their output capacities
+        if is_row_sharded(batch):
+            return batch
+        return super()._maybe_shrink(batch, known_live)
+
+    def _gathered(self, batch: DeviceBatch) -> DeviceBatch:
+        if is_row_sharded(batch):
+            return replicate(batch, self.mesh)
+        return batch
+
+    def _exec_sort(self, plan: L.Sort) -> DeviceBatch:
+        batch = self._gathered(self._exec(plan.input))
+        return self._exec_sort_on(plan, batch)
+
+    def _exec_sort_on(self, plan, batch):
+        # reuse the single-device sort implementation on the gathered batch
+        saved = self._exec
+        try:
+            self._exec = lambda _p: batch  # type: ignore[assignment]
+            return Executor._exec_sort(self, plan)
+        finally:
+            del self._exec
+
+    def _exec_distinct(self, plan: L.Distinct) -> DeviceBatch:
+        batch = self._gathered(self._exec(plan.input))
+        saved_exec = self._exec
+        try:
+            self._exec = lambda _p: batch  # type: ignore[assignment]
+            return Executor._exec_distinct(self, plan)
+        finally:
+            del self._exec
+
+    def _exec_union(self, plan: L.Union) -> DeviceBatch:
+        from igloo_tpu.exec.executor import union_batches
+        batches = [self._gathered(self._exec(ch)) for ch in plan.inputs]
+        return shard_rows(union_batches(batches, plan.schema), self.mesh)
+
+    def _exec_setopjoin(self, plan: L.SetOpJoin) -> DeviceBatch:
+        saved_exec = self._exec
+        gathered = {id(plan.left): None, id(plan.right): None}
+
+        def exec_gathered(p):
+            b = gathered.get(id(p))
+            if b is None:
+                b = self._gathered(saved_exec(p))
+                gathered[id(p)] = b
+            return b
+        try:
+            self._exec = exec_gathered  # type: ignore[assignment]
+            return Executor._exec_setopjoin(self, plan)
+        finally:
+            del self._exec
+
+    # --- sharded aggregate ---
+
+    def _aggregate(self, batch, group_exprs, aggs, out_schema) -> DeviceBatch:
+        if not is_row_sharded(batch) or self.n_dev <= 1:
+            return super()._aggregate(batch, group_exprs, aggs, out_schema)
+        n = self.n_dev
+        comp = ExprCompiler([c.dictionary for c in batch.columns])
+        gres, groups, _ = self._compile_exprs(group_exprs, batch, comp)
+        ares = []
+        compiled_args = []
+        for a in aggs:
+            if a.arg is not None:
+                [r], [arg], _ = self._compile_exprs([a.arg], batch, comp)
+                ares.append(r)
+                compiled_args.append(arg)
+            else:
+                compiled_args.append(None)
+
+        k = len(groups)
+        # partial stage: group keys + decomposed partial aggregates
+        partial_specs: list[AggSpec] = []
+        partial_fields: list[T.Field] = [
+            T.Field(f"g{i}", g.dtype, True) for i, g in enumerate(groups)]
+        # (kind, partial col index/indices) per original agg, for the final stage
+        final_plan = []
+        pi = k
+        for a, arg in zip(aggs, compiled_args):
+            if a.func is E.AggFunc.COUNT_STAR:
+                partial_specs.append(AggSpec(E.AggFunc.COUNT_STAR, None,
+                                             T.INT64, None))
+                partial_fields.append(T.Field(f"a{pi}", T.INT64, False))
+                final_plan.append(("sum_counts", pi, a))
+                pi += 1
+            elif a.func is E.AggFunc.COUNT:
+                partial_specs.append(AggSpec(E.AggFunc.COUNT, arg, T.INT64, None))
+                partial_fields.append(T.Field(f"a{pi}", T.INT64, False))
+                final_plan.append(("sum_counts", pi, a))
+                pi += 1
+            elif a.func is E.AggFunc.AVG:
+                partial_specs.append(AggSpec(E.AggFunc.SUM, arg, T.FLOAT64, None))
+                partial_fields.append(T.Field(f"a{pi}", T.FLOAT64, True))
+                partial_specs.append(AggSpec(E.AggFunc.COUNT, arg, T.INT64, None))
+                partial_fields.append(T.Field(f"a{pi + 1}", T.INT64, False))
+                final_plan.append(("avg", (pi, pi + 1), a))
+                pi += 2
+            elif a.func in _ASSOCIATIVE:
+                out_dict = arg.out_dict if (arg is not None and
+                                            a.dtype.is_string) else None
+                partial_specs.append(AggSpec(a.func, arg, a.dtype, out_dict))
+                partial_fields.append(T.Field(f"a{pi}", a.dtype, True))
+                final_plan.append(("assoc", pi, a))
+                pi += 1
+            else:
+                # non-decomposable aggregate: gather and run single-device
+                return super()._aggregate(self._gathered(batch), group_exprs,
+                                          aggs, out_schema)
+        partial_schema = T.Schema(partial_fields)
+
+        # final stage reads partial columns by index
+        final_groups = [_col_ref(i, g.dtype, g.out_dict)
+                        for i, g in enumerate(groups)]
+        final_specs: list[AggSpec] = []
+        final_fields: list[T.Field] = [
+            T.Field(f"g{i}", g.dtype, True) for i, g in enumerate(groups)]
+        for kind, idx, a in final_plan:
+            if kind == "sum_counts":
+                final_specs.append(AggSpec(
+                    E.AggFunc.SUM, _col_ref(idx, T.INT64), T.INT64, None))
+                final_fields.append(T.Field(f"f{idx}", T.INT64, True))
+            elif kind == "avg":
+                si, ci = idx
+                final_specs.append(AggSpec(
+                    E.AggFunc.SUM, _col_ref(si, T.FLOAT64), T.FLOAT64, None))
+                final_fields.append(T.Field(f"f{si}", T.FLOAT64, True))
+                final_specs.append(AggSpec(
+                    E.AggFunc.SUM, _col_ref(ci, T.INT64), T.INT64, True))
+                final_fields.append(T.Field(f"f{ci}", T.INT64, True))
+            else:
+                pd = partial_schema.fields[idx].dtype
+                out_dict = a_dict = None
+                final_specs.append(AggSpec(
+                    _ASSOCIATIVE[a.func], _col_ref(idx, pd), a.dtype,
+                    partial_specs[idx - k].out_dict))
+                final_fields.append(T.Field(f"f{idx}", a.dtype, True))
+        final_schema = T.Schema(final_fields)
+
+        local_cap = batch.capacity // n
+        if k == 0:
+            # global aggregate: one partial row per shard -> all_gather -> final
+            def local_fn(b, consts):
+                partial = aggregate_batch(b, groups, partial_specs,
+                                          partial_schema, consts)
+                small = K.resize_batch(partial, 8)
+                gathered = jax.tree_util.tree_map(
+                    lambda x: jax.lax.all_gather(x, ROWS, tiled=True), small)
+                final = aggregate_batch(gathered, final_groups, final_specs,
+                                        final_schema, ())
+                return self._fixup_final(final, final_plan, k, out_schema)
+
+            fp = ("shagg_global", expr_fingerprint(gres + ares),
+                  tuple((a.func, a.dtype) for a in aggs),
+                  batch_proto_key(batch), out_schema,
+                  comp.pool.signature(), tuple(comp.marks), n)
+            out = self._jitted_shard_map(
+                "shagg_global", fp, local_fn, out_specs=P())(
+                strip_dicts(batch), comp.pool.device_args())
+            out = attach_dicts(out, [g.out_dict for g in groups] +
+                               self._agg_out_dicts(aggs, compiled_args))
+            return out
+
+        bucket = (default_bucket_cap(local_cap, n) if self._speculate
+                  else local_cap)
+        # final output capacity: ~uniform share of groups with 2x skew headroom
+        out_cap_local = min(n * bucket, max(8, 2 * local_cap))
+
+        def local_fn(b, consts):
+            partial = aggregate_batch(b, groups, partial_specs, partial_schema,
+                                      consts)
+            dest = self._group_dest(partial, k, n)
+            shuffled, ovf1 = shuffle_batch_local(partial, dest, n, bucket, ROWS)
+            final = aggregate_batch(shuffled, final_groups, final_specs,
+                                    final_schema, ())
+            out = self._fixup_final(final, final_plan, k, out_schema)
+            # bound the output capacity (speculative: overflow -> exact re-run)
+            perm = K.compact_perm(out.live)
+            out = K.resize_batch(K.apply_perm(out, perm), out_cap_local)
+            n_groups = jnp.sum(final.live)
+            ovf2 = n_groups > out_cap_local
+            overflow = jax.lax.psum(
+                (ovf1 | ovf2).astype(jnp.int32), ROWS) > 0
+            return out, overflow
+
+        fp = ("shagg", expr_fingerprint(gres + ares),
+              tuple((a.func, a.dtype) for a in aggs),
+              batch_proto_key(batch), out_schema,
+              comp.pool.signature(), tuple(comp.marks), n, bucket,
+              out_cap_local)
+        out, overflow = self._jitted_shard_map(
+            "shagg", fp, local_fn, out_specs=(P(ROWS), P()))(
+            strip_dicts(batch), comp.pool.device_args())
+        self._deferred_overflow.append(overflow)
+        out = attach_dicts(out, [g.out_dict for g in groups] +
+                           self._agg_out_dicts(aggs, compiled_args))
+        return out
+
+    @staticmethod
+    def _agg_out_dicts(aggs, compiled_args):
+        return [arg.out_dict if (arg is not None and a.dtype.is_string) else None
+                for a, arg in zip(aggs, compiled_args)]
+
+    @staticmethod
+    def _group_dest(partial: DeviceBatch, k: int, n: int) -> jax.Array:
+        """Destination device per partial row: hash of the group-key lanes.
+        Dictionary ids hash directly — all shards of a table share one host
+        dictionary, so equal strings have equal ids across shards."""
+        lanes, nulls = [], []
+        for c in partial.columns[:k]:
+            if c.dtype.is_float:
+                for l in K.float_hash_int_lanes(c.values):
+                    lanes.append(l)
+                    nulls.append(c.nulls)
+            else:
+                lanes.append(c.values.astype(jnp.int64))
+                nulls.append(c.nulls)
+        if not lanes:
+            return jnp.zeros((partial.capacity,), dtype=jnp.int32)
+        h = K.hash_lanes(lanes, nulls)
+        return hash_to_dest(h, n)
+
+    @staticmethod
+    def _fixup_final(final: DeviceBatch, final_plan, k: int,
+                     out_schema: T.Schema) -> DeviceBatch:
+        """Final-stage columns -> the plan's aggregate columns (AVG division,
+        COUNT null->0)."""
+        cols = list(final.columns[:k])
+        fi = k
+        for kind, idx, a in final_plan:
+            if kind == "avg":
+                s, c = final.columns[fi], final.columns[fi + 1]
+                cnt = jnp.where(c.nulls, 0, c.values) if c.nulls is not None \
+                    else c.values
+                denom = jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
+                cols.append(DeviceColumn(T.FLOAT64,
+                                         s.values.astype(jnp.float64) / denom,
+                                         cnt == 0, None))
+                fi += 2
+            elif kind == "sum_counts":
+                c = final.columns[fi]
+                vals = jnp.where(c.nulls, 0, c.values) if c.nulls is not None \
+                    else c.values
+                cols.append(DeviceColumn(T.INT64, vals, None, None))
+                fi += 1
+            else:
+                cols.append(final.columns[fi])
+                fi += 1
+        return DeviceBatch(out_schema, cols, final.live)
+
+    # --- sharded join ---
+
+    def _exec_join(self, plan: L.Join) -> DeviceBatch:
+        left = self._exec(plan.left)
+        right = self._exec(plan.right)
+        jt = plan.join_type
+        n = self.n_dev
+        if (n <= 1 or jt is JoinType.CROSS or not plan.left_keys
+                or not self._speculate):
+            # cross / keyless / exact-mode joins run on gathered batches with
+            # the single-device kernel (exact mode needs the per-join count
+            # sync, which has no sharded counterpart yet)
+            return self._join_gathered(plan, left, right)
+        left = left if is_row_sharded(left) else shard_rows(left, self.mesh)
+        right = right if is_row_sharded(right) else shard_rows(right, self.mesh)
+
+        pool = ConstPool()
+        compL = ExprCompiler([c.dictionary for c in left.columns], pool)
+        lres, lk, _ = self._compile_exprs(plan.left_keys, left, compL)
+        compR = ExprCompiler([c.dictionary for c in right.columns], pool)
+        rres, rk, _ = self._compile_exprs(plan.right_keys, right, compR)
+        lhx = make_key_hash_idxs(lk, pool)
+        rhx = make_key_hash_idxs(rk, pool)
+        residual = None
+        rres2 = []
+        marks = tuple(compL.marks) + tuple(compR.marks)
+        if plan.residual is not None:
+            compB = ExprCompiler([c.dictionary for c in left.columns] +
+                                 [c.dictionary for c in right.columns], pool)
+            r = self._resolve_subqueries(plan.residual)
+            rres2 = [r]
+            residual = compB.compile(r)
+            marks = marks + tuple(compB.marks)
+
+        lcap_local = left.capacity // n
+        rcap_local = right.capacity // n
+        lbucket = default_bucket_cap(lcap_local, n)
+        rbucket = default_bucket_cap(rcap_local, n)
+        match_cap = round_capacity(n * max(lbucket, rbucket))
+        # output capacity: per-shard share of an FK join is ~the probe share;
+        # 2x headroom for skew, overflow -> exact re-run
+        out_cap_local = max(8, 2 * max(lcap_local, rcap_local))
+
+        from igloo_tpu.exec.join import _key_lanes
+
+        def local_fn(l, r, consts):
+            env_dest_l = _key_lanes(l, lk, lhx, consts)
+            env_dest_r = _key_lanes(r, rk, rhx, consts)
+            lh = K.hash_lanes([h for kl in env_dest_l for h in kl.hash_ints],
+                              [kl.null for kl in env_dest_l
+                               for _ in kl.hash_ints])
+            rh = K.hash_lanes([h for kl in env_dest_r for h in kl.hash_ints],
+                              [kl.null for kl in env_dest_r
+                               for _ in kl.hash_ints])
+            l2, ovl = shuffle_batch_local(l, hash_to_dest(lh, n), n, lbucket,
+                                          ROWS)
+            r2, ovr = shuffle_batch_local(r, hash_to_dest(rh, n), n, rbucket,
+                                          ROWS)
+            p = probe_phase(l2, r2, lk, rk, lhx, rhx, consts)
+            out = expand_phase(l2, r2, p, match_cap, jt, residual,
+                               plan.schema, consts)
+            ovm = p.total > match_cap
+            # bound output capacity per shard
+            perm = K.compact_perm(out.live)
+            n_out = jnp.sum(out.live)
+            out = K.resize_batch(K.apply_perm(out, perm), out_cap_local)
+            ovo = n_out > out_cap_local
+            overflow = jax.lax.psum(
+                (ovl | ovr | ovm | ovo).astype(jnp.int32), ROWS) > 0
+            return out, overflow
+
+        fp = ("shjoin", expr_fingerprint(lres + rres + rres2), jt,
+              batch_proto_key(left), batch_proto_key(right),
+              pool.signature(), marks, n, lbucket, rbucket, match_cap,
+              out_cap_local, plan.schema)
+        consts = pool.device_args()
+        out, overflow = self._jitted_shard_map(
+            "shjoin", fp,
+            lambda l, r, c: local_fn(l, r, c),
+            out_specs=(P(ROWS), P()), n_batch_args=2)(
+            strip_dicts(left), strip_dicts(right), consts)
+        self._deferred_overflow.append(overflow)
+        if jt in (JoinType.SEMI, JoinType.ANTI):
+            dicts = [c.dictionary for c in left.columns]
+        else:
+            dicts = [c.dictionary for c in left.columns] + \
+                [c.dictionary for c in right.columns]
+        return attach_dicts(out, dicts[: len(out.columns)])
+
+    def _join_gathered(self, plan: L.Join, left: DeviceBatch,
+                       right: DeviceBatch) -> DeviceBatch:
+        left = self._gathered(left)
+        right = self._gathered(right)
+        saved_exec = self._exec
+        pre = {id(plan.left): left, id(plan.right): right}
+
+        def exec_pre(p):
+            b = pre.get(id(p))
+            return b if b is not None else saved_exec(p)
+        try:
+            self._exec = exec_pre  # type: ignore[assignment]
+            return Executor._exec_join(self, plan)
+        finally:
+            del self._exec
+
+    # --- shard_map jit plumbing ---
+
+    def _jitted_shard_map(self, kind: str, fingerprint, local_fn,
+                          out_specs, n_batch_args: int = 1):
+        def build():
+            in_specs = tuple([P(ROWS)] * n_batch_args + [P()])
+            return jax.shard_map(local_fn, mesh=self.mesh,
+                                 in_specs=in_specs, out_specs=out_specs,
+                                 check_vma=False)
+        return self._jitted(kind, fingerprint, build)

@@ -91,6 +91,22 @@ def shuffle_lanes(lanes: list, nulls: list, live: jax.Array, dest: jax.Array,
     return out_lanes, out_nulls, out_live, overflow
 
 
+def shuffle_batch_local(batch, dest: jax.Array, n_dev: int, bucket_cap: int,
+                        axis_name: str):
+    """Local-view (inside shard_map) DeviceBatch shuffle: every live row moves
+    to the device `dest` names. Returns (batch', overflow) where batch' has
+    local capacity n_dev * bucket_cap. Dictionaries are host metadata and are
+    re-attached by the executor outside the traced function."""
+    from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn
+    lanes = [c.values for c in batch.columns]
+    nulls = [c.nulls for c in batch.columns]
+    out_lanes, out_nulls, out_live, overflow = shuffle_lanes(
+        lanes, nulls, batch.live, dest, n_dev, bucket_cap, axis_name)
+    cols = [DeviceColumn(c.dtype, v, nl, None)
+            for c, v, nl in zip(batch.columns, out_lanes, out_nulls)]
+    return DeviceBatch(batch.schema, cols, out_live), overflow
+
+
 def hash_to_dest(hash_lane: jax.Array, n_dev: int) -> jax.Array:
     """Map a combined 64-bit key hash lane to a destination device index.
     Uses high bits (via a multiply-shift) so dest is independent of the low
