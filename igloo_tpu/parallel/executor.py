@@ -130,21 +130,22 @@ class ShardedExecutor(Executor):
 
     def _exec_sort_on(self, plan, batch):
         # reuse the single-device sort implementation on the gathered batch
+        # (restore — not delete — the override so nested overrides survive)
         saved = self._exec
         try:
             self._exec = lambda _p: batch  # type: ignore[assignment]
             return Executor._exec_sort(self, plan)
         finally:
-            del self._exec
+            self._exec = saved  # type: ignore[assignment]
 
     def _exec_distinct(self, plan: L.Distinct) -> DeviceBatch:
         batch = self._gathered(self._exec(plan.input))
-        saved_exec = self._exec
+        saved = self._exec
         try:
             self._exec = lambda _p: batch  # type: ignore[assignment]
             return Executor._exec_distinct(self, plan)
         finally:
-            del self._exec
+            self._exec = saved  # type: ignore[assignment]
 
     def _exec_union(self, plan: L.Union) -> DeviceBatch:
         from igloo_tpu.exec.executor import union_batches
@@ -152,20 +153,24 @@ class ShardedExecutor(Executor):
         return shard_rows(union_batches(batches, plan.schema), self.mesh)
 
     def _exec_setopjoin(self, plan: L.SetOpJoin) -> DeviceBatch:
-        saved_exec = self._exec
+        saved = self._exec
         gathered = {id(plan.left): None, id(plan.right): None}
 
         def exec_gathered(p):
-            b = gathered.get(id(p))
+            # gather (and memoize) ONLY the two set-op inputs; everything
+            # deeper executes through the normal sharded dispatch
+            if id(p) not in gathered:
+                return saved(p)
+            b = gathered[id(p)]
             if b is None:
-                b = self._gathered(saved_exec(p))
+                b = self._gathered(saved(p))
                 gathered[id(p)] = b
             return b
         try:
             self._exec = exec_gathered  # type: ignore[assignment]
             return Executor._exec_setopjoin(self, plan)
         finally:
-            del self._exec
+            self._exec = saved  # type: ignore[assignment]
 
     # --- sharded aggregate ---
 
@@ -242,11 +247,10 @@ class ShardedExecutor(Executor):
                     E.AggFunc.SUM, _col_ref(si, T.FLOAT64), T.FLOAT64, None))
                 final_fields.append(T.Field(f"f{si}", T.FLOAT64, True))
                 final_specs.append(AggSpec(
-                    E.AggFunc.SUM, _col_ref(ci, T.INT64), T.INT64, True))
+                    E.AggFunc.SUM, _col_ref(ci, T.INT64), T.INT64, None))
                 final_fields.append(T.Field(f"f{ci}", T.INT64, True))
             else:
                 pd = partial_schema.fields[idx].dtype
-                out_dict = a_dict = None
                 final_specs.append(AggSpec(
                     _ASSOCIATIVE[a.func], _col_ref(idx, pd), a.dtype,
                     partial_specs[idx - k].out_dict))
@@ -279,8 +283,15 @@ class ShardedExecutor(Executor):
 
         bucket = (default_bucket_cap(local_cap, n) if self._speculate
                   else local_cap)
-        # final output capacity: ~uniform share of groups with 2x skew headroom
-        out_cap_local = min(n * bucket, max(8, 2 * local_cap))
+        if self._speculate:
+            # ~uniform share of groups with 2x skew headroom; overflow flag
+            # triggers an exact re-run
+            out_cap_local = min(n * bucket, max(8, 2 * local_cap))
+        else:
+            # exact mode: a device can receive at most n*bucket partial rows,
+            # so n*bucket groups is a hard bound — no overflow possible (the
+            # speculative fallback must terminate here, not re-overflow)
+            out_cap_local = n * bucket
 
         def local_fn(b, consts):
             partial = aggregate_batch(b, groups, partial_specs, partial_schema,
