@@ -1,0 +1,15 @@
+"""Distributed control plane: coordinator, workers, fragments, Flight client.
+
+The reference declares this tier across four crates (coordinator / worker /
+api / client) and stubs every wire boundary: plans serialize to empty bytes,
+results are fabricated, no server implements the fragment service, and the
+shuffle fetch returns empty (SURVEY.md gaps G1/G2). This package is the
+working version: real plan serde (serde.py), a fragmenting planner with
+partial-aggregate pushdown (fragment.py), a coordinator with liveness
+eviction + elastic fragment re-dispatch (coordinator.py), workers that
+execute fragments and serve peers (worker.py), all over Arrow Flight.
+"""
+from igloo_tpu.cluster.client import DistributedClient
+from igloo_tpu.cluster.fragment import DistributedPlanner, QueryFragment
+
+__all__ = ["DistributedClient", "DistributedPlanner", "QueryFragment"]

@@ -1,0 +1,105 @@
+"""Distributed client: Arrow Flight SQL against the coordinator.
+
+Fills two reference stubs at once: `crates/client/src/main.rs:1-4` (an empty
+binary that was meant to speak Flight SQL) and `pyigloo` (an empty PyO3 crate).
+Any stock Arrow Flight client interoperates — this class is convenience, not
+protocol: `flight.connect(addr).do_get(ticket=sql)` works from any language.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from igloo_tpu.errors import IglooError
+
+
+def _normalize(addr: str) -> str:
+    return addr if "://" in addr else f"grpc+tcp://{addr}"
+
+
+class DistributedClient:
+    def __init__(self, addr: str):
+        self.addr = _normalize(addr)
+        self._client = flight.connect(self.addr)
+
+    # --- health / metadata ---
+
+    def ping(self) -> dict:
+        return self._action("ping")
+
+    def cluster_status(self) -> dict:
+        return self._action("cluster_status")
+
+    def tables(self) -> list[str]:
+        return self.cluster_status()["tables"]
+
+    # --- queries ---
+
+    def execute(self, sql: str) -> pa.Table:
+        """One round trip: the ticket IS the SQL (do_get executes once)."""
+        try:
+            reader = self._client.do_get(flight.Ticket(sql.encode()))
+            return reader.read_all()
+        except flight.FlightError as ex:
+            raise IglooError(_strip_flight(str(ex))) from None
+
+    sql = execute
+
+    def schema(self, sql: str) -> pa.Schema:
+        """Result schema WITHOUT executing (the reference runs the query to
+        answer this — crates/api/src/lib.rs:90-98)."""
+        desc = flight.FlightDescriptor.for_command(sql.encode())
+        try:
+            return self._client.get_schema(desc).schema
+        except flight.FlightError as ex:
+            raise IglooError(_strip_flight(str(ex))) from None
+
+    # --- registration ---
+
+    def register_table(self, name: str, table: pa.Table) -> None:
+        """Upload an in-memory table (Flight do_put; reference: unimplemented)."""
+        desc = flight.FlightDescriptor.for_path(name)
+        writer, _ = self._client.do_put(desc, table.schema)
+        writer.write_table(table)
+        writer.close()
+
+    def register_parquet(self, name: str, path: str) -> None:
+        self._action("register_table",
+                     {"name": name, "spec": {"kind": "parquet", "path": path}})
+
+    def register_csv(self, name: str, path: str, has_header: bool = True,
+                     delimiter: str = ",") -> None:
+        self._action("register_table",
+                     {"name": name, "spec": {"kind": "csv", "path": path,
+                                             "has_header": has_header,
+                                             "delimiter": delimiter}})
+
+    # --- plumbing ---
+
+    def _action(self, name: str, payload: Optional[dict] = None) -> dict:
+        body = json.dumps(payload).encode() if payload is not None else b""
+        try:
+            results = list(self._client.do_action(flight.Action(name, body)))
+        except flight.FlightError as ex:
+            raise IglooError(_strip_flight(str(ex))) from None
+        return json.loads(results[0].body.to_pybytes()) if results else {}
+
+    def close(self) -> None:
+        self._client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _strip_flight(msg: str) -> str:
+    # flight errors carry transport prefixes; keep the engine's message
+    for marker in ("detail: ", "message: "):
+        if marker in msg:
+            msg = msg.split(marker, 1)[1]
+    return msg.split(". gRPC client debug context")[0].strip()

@@ -257,6 +257,50 @@ def _rx(j, catalog) -> Optional[E.Expr]:
     return e
 
 
+# --- provider specs (how a worker re-creates a coordinator table) ---
+
+
+def provider_to_spec(provider) -> Optional[dict]:
+    """Shippable description of a table provider, or None if the provider
+    cannot be reconstructed remotely (then its data ships as Arrow IPC)."""
+    from igloo_tpu.catalog import MemTable
+    from igloo_tpu.connectors.csv import CsvTable
+    from igloo_tpu.connectors.iceberg import IcebergTable
+    from igloo_tpu.connectors.parquet import ParquetTable
+    if isinstance(provider, ParquetTable):
+        return {"kind": "parquet", "path": provider.path}
+    if isinstance(provider, CsvTable):
+        return {"kind": "csv", "path": provider.path,
+                "has_header": provider.has_header,
+                "delimiter": provider.delimiter}
+    if isinstance(provider, IcebergTable):
+        return {"kind": "iceberg", "path": provider.path}
+    if isinstance(provider, MemTable):
+        import base64
+        return {"kind": "ipc",
+                "data": base64.b64encode(table_to_ipc(provider.read())).decode()}
+    return None
+
+
+def provider_from_spec(spec: dict):
+    kind = spec["kind"]
+    if kind == "parquet":
+        from igloo_tpu.connectors.parquet import ParquetTable
+        return ParquetTable(spec["path"])
+    if kind == "csv":
+        from igloo_tpu.connectors.csv import CsvTable
+        return CsvTable(spec["path"], has_header=spec.get("has_header", True),
+                        delimiter=spec.get("delimiter", ","))
+    if kind == "iceberg":
+        from igloo_tpu.connectors.iceberg import IcebergTable
+        return IcebergTable(spec["path"])
+    if kind == "ipc":
+        import base64
+        from igloo_tpu.catalog import MemTable
+        return MemTable(table_from_ipc(base64.b64decode(spec["data"])))
+    raise PlanError(f"unknown provider spec kind: {kind}")
+
+
 # --- Arrow IPC result codec ---
 
 

@@ -142,9 +142,10 @@ class IcebergTable:
     def snapshot(self):
         """Iceberg snapshot token: metadata file + data files (paths, mtimes,
         sizes). A new table commit writes a new metadata version, changing the
-        token; read() re-resolves the file list so the fresh data is actually
-        served after invalidation."""
+        token; _refresh() here AND in read()/read_partition() keeps the served
+        file list consistent with the version the token is computed from."""
         from igloo_tpu.connectors.parquet import file_snapshot
+        self._refresh()
         meta = self._metadata_file()
         return file_snapshot(([meta] if meta else []) + self._files)
 
@@ -164,10 +165,12 @@ class IcebergTable:
 
     def read(self, projection: Optional[list[str]] = None,
              filters: Optional[list] = None) -> pa.Table:
+        self._refresh()
         tables = [self._read_file(f, projection, filters) for f in self._files]
         return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
 
     def read_partition(self, index, projection=None, filters=None) -> pa.Table:
+        self._refresh()
         return self._read_file(self._files[index], projection, filters)
 
     def _read_file(self, path, projection, filters) -> pa.Table:

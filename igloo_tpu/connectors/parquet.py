@@ -26,7 +26,10 @@ class ParquetTable:
     """One file, a directory of files, or a glob pattern."""
 
     def __init__(self, path: str):
+        import threading
         self.path = path
+        self._parts = None  # lazy (file, row_group) partition index
+        self._plock = threading.Lock()  # guards _files/_parts (Flight threads)
         self._files = _expand(path)
         if not self._files:
             raise ConnectorError(f"no parquet files at {path}")
@@ -41,11 +44,38 @@ class ParquetTable:
         return self._schema
 
     def snapshot(self):
-        """Cache/CDC token: changes when any underlying file changes on disk."""
-        return file_snapshot(self._files)
+        """Cache/CDC token: changes when any underlying file changes on disk
+        (re-globs directory/glob paths so added files are seen — and drops the
+        stale partition index when the file set moved)."""
+        files = _expand(self.path)
+        with self._plock:
+            if files and files != self._files:
+                self._files = files
+                self._parts = None
+            files = list(self._files)
+        return file_snapshot(files)
+
+    def _partition_index(self) -> list[tuple[str, int]]:
+        """(file, row_group) pairs — the scan's parallel/chunking unit. Row
+        groups (not whole files) so a single large file still distributes
+        across workers / chunks (reference analog: fixed 1024-row read batches,
+        parquet_scan.rs:54, which never leave the single stream). Lock-guarded:
+        Flight serves fragments on concurrent threads, and snapshot() may drop
+        the index when the file set moves."""
+        with self._plock:
+            if self._parts is None:
+                parts: list[tuple[str, int]] = []
+                for f in self._files:
+                    try:
+                        n = pq.ParquetFile(f).metadata.num_row_groups
+                    except Exception:
+                        n = 1
+                    parts.extend((f, i) for i in range(max(n, 1)))
+                self._parts = parts
+            return self._parts
 
     def num_partitions(self) -> int:
-        return len(self._files)
+        return len(self._partition_index())
 
     def read(self, projection: Optional[list[str]] = None,
              filters: Optional[list] = None) -> pa.Table:
@@ -53,7 +83,19 @@ class ParquetTable:
         return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
 
     def read_partition(self, index: int, projection=None, filters=None) -> pa.Table:
-        return self._read_file(self._files[index], projection, filters)
+        path, rg = self._partition_index()[index]
+        try:
+            pf = pq.ParquetFile(path)
+            groups = _prune_row_groups(pf, filters)
+            if groups is not None and rg not in groups:
+                return pf.schema_arrow.empty_table() if projection is None \
+                    else pf.schema_arrow.empty_table().select(projection)
+            return pf.read_row_groups([rg], columns=projection)
+        except ConnectorError:
+            raise
+        except Exception as ex:
+            raise ConnectorError(
+                f"parquet read failed for {path} rg{rg}: {ex}") from None
 
     def _read_file(self, path: str, projection, filters) -> pa.Table:
         try:

@@ -21,6 +21,13 @@ from igloo_tpu.exec.batch import DeviceBatch
 from igloo_tpu.utils.tracing import counter
 
 
+def scan_table_key(name: str) -> str:
+    """Canonical cache key for a table name: the binder sets Scan.table to the
+    last dotted component lowercased (plan/binder.py), so every invalidation
+    path must reduce qualified catalog names ("db.tbl") the same way."""
+    return name.split(".")[-1].lower()
+
+
 @dataclass
 class CacheEntry:
     batch: DeviceBatch
@@ -79,9 +86,11 @@ class BatchCache:
 
     def invalidate_table(self, table: str) -> int:
         """Drop every cached batch for `table` (CDC invalidation bus entry
-        point). Returns the number of entries dropped."""
+        point). Returns the number of entries dropped. `table` may be a
+        qualified catalog name; it is canonicalized to the scan key."""
+        key = scan_table_key(table)
         with self._lock:
-            doomed = [k for k in self._entries if k and k[0] == table]
+            doomed = [k for k in self._entries if k and k[0] == key]
             for k in doomed:
                 self._bytes -= self._entries.pop(k).nbytes
             return len(doomed)

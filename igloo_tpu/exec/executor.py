@@ -47,6 +47,22 @@ from igloo_tpu.utils import tracing
 _SHRINK_FACTOR = 4  # shrink a batch when capacity > factor * needed
 
 
+def read_scan_table(plan: L.Scan) -> pa.Table:
+    """Host-side scan IO honoring the plan's partition restriction. Replaces
+    the reference's whole-table-only reads (parquet_scan.rs streams fixed
+    1024-row batches but custom operators are single-stream) with explicit
+    provider partitions the distributed planner / chunked executor slice."""
+    if plan.partition is None:
+        return plan.provider.read(projection=plan.projection,
+                                  filters=plan.pushed_filters)
+    parts = [plan.provider.read_partition(i, projection=plan.projection,
+                                          filters=plan.pushed_filters)
+             for i in plan.partition]
+    return pa.concat_tables(parts) if parts else \
+        plan.provider.read(projection=plan.projection,
+                           filters=plan.pushed_filters).slice(0, 0)
+
+
 def batch_proto_key(batch: DeviceBatch):
     """Hashable prototype of a batch: everything that affects tracing. NOTE:
     deliberately dictionary-free — dictionary content reaches compiled code
@@ -200,13 +216,12 @@ class Executor:
             from igloo_tpu.exec.cache import provider_snapshot
             key = (plan.table,
                    tuple(plan.projection) if plan.projection is not None else None,
-                   expr_fingerprint(plan.pushed_filters))
+                   expr_fingerprint(plan.pushed_filters), plan.partition)
             snap = provider_snapshot(plan.provider)
             hit = self._batch_cache.get(key, snap)
             if hit is not None:
                 return hit
-        table = plan.provider.read(projection=plan.projection,
-                                   filters=plan.pushed_filters)
+        table = read_scan_table(plan)
         if plan.projection is not None:
             table = table.select(plan.projection)
         batch = from_arrow(table, schema=plan.schema)

@@ -91,15 +91,15 @@ class ShardedExecutor(Executor):
         key = snap = None
         if self._batch_cache is not None:
             from igloo_tpu.exec.cache import provider_snapshot
-            key = ("sharded", self.n_dev, plan.table,
+            key = (plan.table, "sharded", self.n_dev,
                    tuple(plan.projection) if plan.projection is not None else None,
-                   expr_fingerprint(plan.pushed_filters))
+                   expr_fingerprint(plan.pushed_filters), plan.partition)
             snap = provider_snapshot(plan.provider)
             hit = self._batch_cache.get(key, snap)
             if hit is not None:
                 return hit
-        table = plan.provider.read(projection=plan.projection,
-                                   filters=plan.pushed_filters)
+        from igloo_tpu.exec.executor import read_scan_table
+        table = read_scan_table(plan)
         if plan.projection is not None:
             table = table.select(plan.projection)
         batch = shard_rows(from_arrow(table, schema=plan.schema), self.mesh)

@@ -42,10 +42,14 @@ class Scan(LogicalPlan):
     provider: object = None  # TableProvider
     projection: Optional[list[str]] = None
     pushed_filters: list[E.Expr] = field(default_factory=list)
+    # restrict the scan to these provider partition indices (distributed /
+    # chunked execution); None = whole table
+    partition: Optional[tuple[int, ...]] = None
 
     def node_name(self):
         cols = f" cols={self.projection}" if self.projection is not None else ""
-        return f"Scan({self.table}{cols})"
+        part = f" part={list(self.partition)}" if self.partition is not None else ""
+        return f"Scan({self.table}{cols}{part})"
 
 
 @dataclass

@@ -79,10 +79,10 @@ def test_parquet_snapshot_cdc_invalidation(tmp_path):
     # register a change listener (the distributed tier's broadcast hook)
     seen = []
     watcher.on_change(seen.append)
-    # note: provider re-reads files on read(); snapshot() sees new mtime
-    eng.register_table("t", ParquetTable(path))
-    assert "t" in watcher.poll() or eng.execute(
-        "SELECT sum(a) AS s FROM t").column("s").to_pylist() == [100]
+    # change detection must fire through the ORIGINAL provider — no
+    # re-registration, no fallback: poll() itself must evict the stale entry
+    assert watcher.poll() == ["t"]
+    assert seen == ["t"]
     assert eng.execute("SELECT sum(a) AS s FROM t").column("s").to_pylist() == [100]
 
 
