@@ -50,7 +50,8 @@ _CONTAINERS_P1 = ["JUMBO", "LG", "MED", "SM", "WRAP"]
 _CONTAINERS_P2 = ["BAG", "BOX", "CAN", "CASE", "DRUM", "JAR", "PACK", "PKG"]
 _WORDS = ("the quick final pending special express regular furious ironic "
           "bold even silent slow careful deposits requests accounts foxes "
-          "packages theodolites instructions pinto beans").split()
+          "packages theodolites instructions pinto beans "
+          "green forest lavender misty").split()
 
 
 def _comments(rng, n, lo=2, hi=6):
@@ -144,7 +145,10 @@ def gen_tables(sf: float = 0.01, seed: int = 19980401) -> dict[str, pa.Table]:
     })
 
     n_ord = max(int(1_500_000 * sf), 150)
+    # dbgen rule: custkeys divisible by 3 never place orders (drives q13's
+    # zero-order bucket and q22's NOT EXISTS branch)
     o_cust = rng.integers(1, n_cust + 1, n_ord)
+    o_cust = np.where(o_cust % 3 == 0, np.maximum(o_cust - 1, 1), o_cust)
     o_date = rng.integers(_START, _END - 151, n_ord)
     out["orders"] = pa.table({
         "o_orderkey": pa.array(np.arange(1, n_ord + 1), type=pa.int64()),
@@ -226,6 +230,23 @@ QUERIES: dict[str, str] = {
         GROUP BY l_returnflag, l_linestatus
         ORDER BY l_returnflag, l_linestatus
     """,
+    "q2": """
+        SELECT s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address,
+               s_phone, s_comment
+        FROM part, supplier, partsupp, nation, region
+        WHERE p_partkey = ps_partkey AND s_suppkey = ps_suppkey
+          AND p_size = 15 AND p_type LIKE '%BRASS'
+          AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+          AND r_name = 'EUROPE'
+          AND ps_supplycost = (SELECT min(ps_supplycost)
+                               FROM partsupp, supplier, nation, region
+                               WHERE p_partkey = ps_partkey
+                                 AND s_suppkey = ps_suppkey
+                                 AND s_nationkey = n_nationkey
+                                 AND n_regionkey = r_regionkey
+                                 AND r_name = 'EUROPE')
+        ORDER BY s_acctbal DESC, n_name, s_name, p_partkey LIMIT 100
+    """,
     "q3": """
         SELECT l_orderkey,
                sum(l_extendedprice * (1 - l_discount)) AS revenue,
@@ -266,6 +287,56 @@ QUERIES: dict[str, str] = {
           AND l_discount BETWEEN 0.05 AND 0.07
           AND l_quantity < 24
     """,
+    "q7": """
+        SELECT supp_nation, cust_nation, l_year, sum(volume) AS revenue
+        FROM (SELECT n1.n_name AS supp_nation, n2.n_name AS cust_nation,
+                     EXTRACT(YEAR FROM l_shipdate) AS l_year,
+                     l_extendedprice * (1 - l_discount) AS volume
+              FROM supplier, lineitem, orders, customer, nation n1, nation n2
+              WHERE s_suppkey = l_suppkey AND o_orderkey = l_orderkey
+                AND c_custkey = o_custkey AND s_nationkey = n1.n_nationkey
+                AND c_nationkey = n2.n_nationkey
+                AND ((n1.n_name = 'FRANCE' AND n2.n_name = 'GERMANY')
+                  OR (n1.n_name = 'GERMANY' AND n2.n_name = 'FRANCE'))
+                AND l_shipdate BETWEEN DATE '1995-01-01' AND DATE '1996-12-31'
+             ) AS shipping
+        GROUP BY supp_nation, cust_nation, l_year
+        ORDER BY supp_nation, cust_nation, l_year
+    """,
+    "q8": """
+        SELECT o_year,
+               sum(CASE WHEN nation = 'BRAZIL' THEN volume ELSE 0 END)
+               / sum(volume) AS mkt_share
+        FROM (SELECT EXTRACT(YEAR FROM o_orderdate) AS o_year,
+                     l_extendedprice * (1 - l_discount) AS volume,
+                     n2.n_name AS nation
+              FROM part, supplier, lineitem, orders, customer,
+                   nation n1, nation n2, region
+              WHERE p_partkey = l_partkey AND s_suppkey = l_suppkey
+                AND l_orderkey = o_orderkey AND o_custkey = c_custkey
+                AND c_nationkey = n1.n_nationkey
+                AND n1.n_regionkey = r_regionkey AND r_name = 'AMERICA'
+                AND s_nationkey = n2.n_nationkey
+                AND o_orderdate BETWEEN DATE '1995-01-01' AND DATE '1996-12-31'
+                AND p_type = 'ECONOMY ANODIZED STEEL'
+             ) AS all_nations
+        GROUP BY o_year ORDER BY o_year
+    """,
+    "q9": """
+        SELECT nation, o_year, sum(amount) AS sum_profit
+        FROM (SELECT n_name AS nation,
+                     EXTRACT(YEAR FROM o_orderdate) AS o_year,
+                     l_extendedprice * (1 - l_discount)
+                       - ps_supplycost * l_quantity AS amount
+              FROM part, supplier, lineitem, partsupp, orders, nation
+              WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey
+                AND ps_partkey = l_partkey AND p_partkey = l_partkey
+                AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+                AND p_name LIKE '%green%'
+             ) AS profit
+        GROUP BY nation, o_year
+        ORDER BY nation, o_year DESC
+    """,
     "q10": """
         SELECT c_custkey, c_name,
                sum(l_extendedprice * (1 - l_discount)) AS revenue,
@@ -278,6 +349,19 @@ QUERIES: dict[str, str] = {
         GROUP BY c_custkey, c_name, c_acctbal, c_phone, n_name, c_address,
                  c_comment
         ORDER BY revenue DESC LIMIT 20
+    """,
+    "q11": """
+        SELECT ps_partkey, sum(ps_supplycost * ps_availqty) AS value
+        FROM partsupp, supplier, nation
+        WHERE ps_suppkey = s_suppkey AND s_nationkey = n_nationkey
+          AND n_name = 'GERMANY'
+        GROUP BY ps_partkey
+        HAVING sum(ps_supplycost * ps_availqty) >
+               (SELECT sum(ps_supplycost * ps_availqty) * 0.0001
+                FROM partsupp, supplier, nation
+                WHERE ps_suppkey = s_suppkey AND s_nationkey = n_nationkey
+                  AND n_name = 'GERMANY')
+        ORDER BY value DESC
     """,
     "q12": """
         SELECT l_shipmode,
@@ -295,6 +379,16 @@ QUERIES: dict[str, str] = {
           AND l_receiptdate < DATE '1994-01-01' + INTERVAL '1' YEAR
         GROUP BY l_shipmode ORDER BY l_shipmode
     """,
+    "q13": """
+        SELECT c_count, count(*) AS custdist
+        FROM (SELECT c_custkey, count(o_orderkey) AS c_count
+              FROM customer LEFT JOIN orders
+                ON c_custkey = o_custkey
+                   AND o_comment NOT LIKE '%special%requests%'
+              GROUP BY c_custkey) AS c_orders
+        GROUP BY c_count
+        ORDER BY custdist DESC, c_count DESC
+    """,
     "q14": """
         SELECT 100.00 * sum(CASE WHEN p_type LIKE 'PROMO%'
                                  THEN l_extendedprice * (1 - l_discount)
@@ -304,6 +398,20 @@ QUERIES: dict[str, str] = {
         WHERE l_partkey = p_partkey
           AND l_shipdate >= DATE '1995-09-01'
           AND l_shipdate < DATE '1995-09-01' + INTERVAL '1' MONTH
+    """,
+    "q15": """
+        WITH revenue AS (
+            SELECT l_suppkey AS supplier_no,
+                   sum(l_extendedprice * (1 - l_discount)) AS total_revenue
+            FROM lineitem
+            WHERE l_shipdate >= DATE '1996-01-01'
+              AND l_shipdate < DATE '1996-01-01' + INTERVAL '3' MONTH
+            GROUP BY l_suppkey)
+        SELECT s_suppkey, s_name, s_address, s_phone, total_revenue
+        FROM supplier, revenue
+        WHERE s_suppkey = supplier_no
+          AND total_revenue = (SELECT max(total_revenue) FROM revenue)
+        ORDER BY s_suppkey
     """,
     "q16": """
         SELECT p_brand, p_type, p_size,
@@ -316,6 +424,14 @@ QUERIES: dict[str, str] = {
         GROUP BY p_brand, p_type, p_size
         ORDER BY supplier_cnt DESC, p_brand, p_type, p_size
         LIMIT 20
+    """,
+    "q17": """
+        SELECT sum(l_extendedprice) / 7.0 AS avg_yearly
+        FROM lineitem, part
+        WHERE p_partkey = l_partkey AND p_brand = 'Brand#23'
+          AND p_container = 'MED BOX'
+          AND l_quantity < (SELECT 0.2 * avg(l_quantity)
+                            FROM lineitem WHERE l_partkey = p_partkey)
     """,
     "q18": """
         SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
@@ -338,5 +454,54 @@ QUERIES: dict[str, str] = {
             OR (p_brand = 'Brand#34'
                 AND l_quantity >= 20 AND l_quantity <= 30 AND p_size BETWEEN 1 AND 15))
           AND l_shipmode IN ('AIR', 'REG AIR')
+    """,
+    "q20": """
+        SELECT s_name, s_address
+        FROM supplier, nation
+        WHERE s_suppkey IN (
+                SELECT ps_suppkey FROM partsupp
+                WHERE ps_partkey IN (SELECT p_partkey FROM part
+                                     WHERE p_name LIKE 'forest%')
+                  AND ps_availqty > (SELECT 0.5 * sum(l_quantity)
+                                     FROM lineitem
+                                     WHERE l_partkey = ps_partkey
+                                       AND l_suppkey = ps_suppkey
+                                       AND l_shipdate >= DATE '1994-01-01'
+                                       AND l_shipdate < DATE '1994-01-01'
+                                           + INTERVAL '1' YEAR))
+          AND s_nationkey = n_nationkey AND n_name = 'CANADA'
+        ORDER BY s_name
+    """,
+    "q21": """
+        SELECT s_name, count(*) AS numwait
+        FROM supplier, lineitem l1, orders, nation
+        WHERE s_suppkey = l1.l_suppkey AND o_orderkey = l1.l_orderkey
+          AND o_orderstatus = 'F' AND l1.l_receiptdate > l1.l_commitdate
+          AND EXISTS (SELECT 1 FROM lineitem l2
+                      WHERE l2.l_orderkey = l1.l_orderkey
+                        AND l2.l_suppkey <> l1.l_suppkey)
+          AND NOT EXISTS (SELECT 1 FROM lineitem l3
+                          WHERE l3.l_orderkey = l1.l_orderkey
+                            AND l3.l_suppkey <> l1.l_suppkey
+                            AND l3.l_receiptdate > l3.l_commitdate)
+          AND s_nationkey = n_nationkey AND n_name = 'SAUDI ARABIA'
+        GROUP BY s_name
+        ORDER BY numwait DESC, s_name LIMIT 100
+    """,
+    "q22": """
+        SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+        FROM (SELECT substring(c_phone, 1, 2) AS cntrycode, c_acctbal
+              FROM customer
+              WHERE substring(c_phone, 1, 2) IN
+                    ('13', '31', '23', '29', '30', '18', '17')
+                AND c_acctbal > (SELECT avg(c_acctbal) FROM customer
+                                 WHERE c_acctbal > 0.00
+                                   AND substring(c_phone, 1, 2) IN
+                                       ('13', '31', '23', '29', '30', '18', '17'))
+                AND NOT EXISTS (SELECT 1 FROM orders
+                                WHERE o_custkey = c_custkey)
+             ) AS custsale
+        GROUP BY cntrycode
+        ORDER BY cntrycode
     """,
 }

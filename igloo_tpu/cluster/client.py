@@ -16,8 +16,7 @@ import pyarrow.flight as flight
 from igloo_tpu.errors import IglooError
 
 
-def _normalize(addr: str) -> str:
-    return addr if "://" in addr else f"grpc+tcp://{addr}"
+from igloo_tpu.cluster.rpc import normalize as _normalize
 
 
 class DistributedClient:

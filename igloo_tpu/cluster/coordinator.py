@@ -33,6 +33,7 @@ import pyarrow.flight as flight
 
 from igloo_tpu.cluster import serde
 from igloo_tpu.cluster.fragment import DistributedPlanner, QueryFragment
+from igloo_tpu.cluster.rpc import flight_action, flight_get_table
 from igloo_tpu.engine import QueryEngine
 from igloo_tpu.errors import IglooError
 from igloo_tpu.utils import tracing
@@ -155,7 +156,7 @@ class DistributedExecutor:
                         self._recover(dead, frags, completed, pending)
                 return self._fetch(completed[root_id], root_id)
         finally:
-            self._release(completed, list(frags))
+            self._release(frags, completed, list(frags))
 
     # --- internals ---
 
@@ -166,12 +167,7 @@ class DistributedExecutor:
         req = {"id": f.id, "plan": f.plan,
                "deps": [{"id": d, "addr": completed[d]} for d in f.deps]}
         try:
-            client = flight.connect(f.worker)
-            try:
-                list(client.do_action(flight.Action(
-                    "execute_fragment", json.dumps(req).encode())))
-            finally:
-                client.close()
+            flight_action(f.worker, "execute_fragment", req)
         except flight.FlightServerError as ex:
             marker = "DEP_UNAVAILABLE:"
             msg = str(ex)
@@ -206,21 +202,17 @@ class DistributedExecutor:
                 tracing.counter("coordinator.fragments_redispatched")
 
     def _fetch(self, addr: str, frag_id: str) -> pa.Table:
-        client = flight.connect(addr)
-        try:
-            return client.do_get(flight.Ticket(frag_id.encode())).read_all()
-        finally:
-            client.close()
+        return flight_get_table(addr, frag_id)
 
-    def _release(self, completed: dict[str, str], ids: list[str]) -> None:
-        for addr in set(completed.values()):
+    def _release(self, frags: dict[str, QueryFragment],
+                 completed: dict[str, str], ids: list[str]) -> None:
+        # every worker a fragment was ASSIGNED to, not just recorded holders:
+        # a wave that errored out mid-collection leaves results on workers
+        # whose completions were never processed
+        addrs = set(completed.values()) | {f.worker for f in frags.values()}
+        for addr in addrs:
             try:
-                client = flight.connect(addr)
-                try:
-                    list(client.do_action(flight.Action(
-                        "release", json.dumps({"ids": ids}).encode())))
-                finally:
-                    client.close()
+                flight_action(addr, "release", {"ids": ids})
             except Exception:
                 pass  # worker gone; nothing to release
 
@@ -239,8 +231,15 @@ class CoordinatorServer(flight.FlightServerBase):
     """The cluster's front door + control plane on ONE Flight endpoint."""
 
     def __init__(self, location: str, worker_timeout_s: float = 15.0,
-                 use_jit: bool = True, **kw):
+                 use_jit: bool = True, advertise_host: Optional[str] = None,
+                 **kw):
         super().__init__(location, **kw)
+        if advertise_host is None:
+            # endpoint host clients are told to come back to: the bound host
+            # (unless wildcard-bound, where loopback is the only safe default)
+            host = location.split("://")[-1].rsplit(":", 1)[0]
+            advertise_host = host if host and host != "0.0.0.0" else "127.0.0.1"
+        self.advertise_host = advertise_host
         self.engine = QueryEngine(use_jit=use_jit)
         self.membership = Membership(worker_timeout_s)
         self.executor = DistributedExecutor(self.membership)
@@ -273,13 +272,8 @@ class CoordinatorServer(flight.FlightServerBase):
                     w.tables_pushed.discard(name.lower())
 
     def _push_table(self, w: WorkerState, name: str, spec: dict) -> None:
-        client = flight.connect(w.addr)
-        try:
-            list(client.do_action(flight.Action("register_table", json.dumps(
-                {"name": name, "spec": spec}).encode())))
-            w.tables_pushed.add(name.lower())
-        finally:
-            client.close()
+        flight_action(w.addr, "register_table", {"name": name, "spec": spec})
+        w.tables_pushed.add(name.lower())
 
     def _sync_worker_tables(self, w: WorkerState) -> None:
         with self._lock:
@@ -301,8 +295,18 @@ class CoordinatorServer(flight.FlightServerBase):
         except IglooError:
             # non-SELECT statements (SHOW/DESCRIBE/CTAS/...) run locally
             return self.engine.execute(sql)
+        synced = []
         for w in live:
-            self._sync_worker_tables(w)
+            try:
+                self._sync_worker_tables(w)
+                synced.append(w)
+            except Exception:
+                # unreachable mid-sweep: evict now instead of failing every
+                # query until the sweeper notices
+                self.membership.evict(w.worker_id)
+        live = synced
+        if not live:
+            return self.engine.execute(sql)
         # only distribute plans whose base tables every worker can resolve
         if not self._distributable(plan):
             return self.engine.execute(sql)
@@ -404,7 +408,7 @@ class CoordinatorServer(flight.FlightServerBase):
     # --- helpers ---
 
     def _public_location(self) -> str:
-        return f"grpc+tcp://127.0.0.1:{self.port}"
+        return f"grpc+tcp://{self.advertise_host}:{self.port}"
 
     @staticmethod
     def _descriptor_sql(descriptor) -> str:

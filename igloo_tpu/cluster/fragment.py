@@ -106,7 +106,7 @@ class DistributedPlanner:
         """-> fragments in dependency-safe order; the LAST one is the root."""
         frags: list[QueryFragment] = []
         root_plan = self._split(plan, frags)
-        root = self._make_fragment(root_plan, frags_out=frags)
+        self._make_fragment(root_plan, frags_out=frags)  # appends the root
         return frags
 
     # --- internals ---

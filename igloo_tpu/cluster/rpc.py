@@ -1,0 +1,32 @@
+"""Shared Flight RPC plumbing for the cluster package."""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import pyarrow.flight as flight
+
+
+def normalize(addr: str) -> str:
+    return addr if "://" in addr else f"grpc+tcp://{addr}"
+
+
+def flight_action(addr: str, name: str, payload: Optional[dict] = None) -> dict:
+    """One-shot action RPC: connect, act, close. Returns the decoded first
+    result (or {})."""
+    client = flight.connect(normalize(addr))
+    try:
+        body = json.dumps(payload).encode() if payload is not None else b""
+        results = list(client.do_action(flight.Action(name, body)))
+    finally:
+        client.close()
+    return json.loads(results[0].body.to_pybytes()) if results else {}
+
+
+def flight_get_table(addr: str, ticket: str):
+    """One-shot do_get RPC returning the full Arrow table."""
+    client = flight.connect(normalize(addr))
+    try:
+        return client.do_get(flight.Ticket(ticket.encode())).read_all()
+    finally:
+        client.close()

@@ -27,8 +27,9 @@ import pyarrow.flight as flight
 
 from igloo_tpu.catalog import Catalog, MemTable
 from igloo_tpu.cluster import serde
-from igloo_tpu.cluster.client import _normalize
 from igloo_tpu.cluster.fragment import FRAG_PREFIX
+from igloo_tpu.cluster.rpc import flight_action, flight_get_table
+from igloo_tpu.cluster.rpc import normalize as _normalize
 from igloo_tpu.errors import IglooError
 from igloo_tpu.utils import tracing
 
@@ -79,12 +80,7 @@ class WorkerServer(flight.FlightServerBase):
         # an unreachable peer is reported with a marker the coordinator
         # recognizes (it requeues the dependency on a live worker)
         try:
-            client = flight.connect(addr)
-            try:
-                reader = client.do_get(flight.Ticket(frag_id.encode()))
-                table = reader.read_all()
-            finally:
-                client.close()
+            table = flight_get_table(addr, frag_id)
         except Exception as ex:
             raise IglooError(f"DEP_UNAVAILABLE:{frag_id} peer {addr}: {ex}")
         with self._lock:
@@ -176,13 +172,7 @@ class Worker:
         self._hb_thread.start()
 
     def _coordinator_action(self, name: str, payload: dict) -> dict:
-        client = flight.connect(self.coordinator)
-        try:
-            results = list(client.do_action(flight.Action(
-                name, json.dumps(payload).encode())))
-        finally:
-            client.close()
-        return json.loads(results[0].body.to_pybytes()) if results else {}
+        return flight_action(self.coordinator, name, payload)
 
     def _register(self) -> None:
         self._coordinator_action("register_worker", {

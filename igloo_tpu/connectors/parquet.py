@@ -83,8 +83,11 @@ class ParquetTable:
         return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
 
     def read_partition(self, index: int, projection=None, filters=None) -> pa.Table:
-        path, rg = self._partition_index()[index]
         try:
+            # inside the try: the index is mutable (snapshot() re-globs), so a
+            # planned partition id can go stale mid-query — surface it as a
+            # ConnectorError, not a bare IndexError
+            path, rg = self._partition_index()[index]
             pf = pq.ParquetFile(path)
             groups = _prune_row_groups(pf, filters)
             if groups is not None and rg not in groups:
@@ -95,7 +98,8 @@ class ParquetTable:
             raise
         except Exception as ex:
             raise ConnectorError(
-                f"parquet read failed for {path} rg{rg}: {ex}") from None
+                f"parquet partition {index} read failed for {self.path}: "
+                f"{ex}") from None
 
     def _read_file(self, path: str, projection, filters) -> pa.Table:
         try:

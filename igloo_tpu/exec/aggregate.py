@@ -36,11 +36,40 @@ class AggSpec:
     out_dict: Optional[DictInfo]  # MIN/MAX over strings keep the arg dictionary
 
 
+def seg_dims_for(groups: list[Compiled]) -> Optional[tuple[int, ...]]:
+    """If every group key is directly indexable — a dictionary-encoded string
+    (ids in [0, len)) or a boolean — return per-key bucket counts (+1 for the
+    NULL bucket). The aggregate then scatters straight into `prod(dims)`
+    segments instead of lex-sorting every input lane (the sort is O(n log n)
+    over the FULL batch capacity; Q1 groups 8M lanes into 6 buckets).
+    Host-side decision: callers must fold the result into their jit cache key
+    (dictionary LENGTH is content, not shape — two same-shape-bucket
+    dictionaries may differ in size)."""
+    dims = []
+    for g in groups:
+        if g.dtype is T.BOOL:
+            dims.append(3)
+        elif g.dtype.is_string and g.out_dict is not None:
+            dims.append(len(g.out_dict.values) + 1)
+        else:
+            return None
+    prod = 1
+    for d in dims:
+        prod *= d
+    if not dims or prod > (1 << 16):
+        return None
+    return tuple(dims)
+
+
 def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
                     aggs: list[AggSpec], out_schema: T.Schema,
-                    consts: tuple = ()) -> DeviceBatch:
+                    consts: tuple = (),
+                    seg_dims: Optional[tuple[int, ...]] = None) -> DeviceBatch:
     """Pure, jit-traceable: DeviceBatch -> DeviceBatch of one row per group.
-    Output columns carry no dictionaries — the executor re-attaches them."""
+    Output columns carry no dictionaries — the executor re-attaches them.
+    `seg_dims` (from seg_dims_for, included in the caller's cache key) selects
+    the direct-scatter fast path; output capacity is then the padded segment
+    count, not the input capacity."""
     env = Env.from_batch(batch, consts)
     cap = batch.capacity
     live = batch.live
@@ -53,32 +82,31 @@ def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
         gvals.append(v)
         gnulls.append(nl)
 
-    if groups:
-        # equality lanes (string ids are already ranks; floats decompose into
-        # nan-flag + normalized-value lanes — no 64-bit bitcasts, TPU-safe)
-        flat_lanes: list = []
-        flat_nulls: list = []
-        sort_lanes: list = []
-        for v, nl, g in zip(gvals, gnulls, groups):
-            for lane in K.group_lanes_for(v, g.dtype.is_float):
-                flat_lanes.append(lane)
-                flat_nulls.append(nl)
-            sort_lanes.extend(K.sort_lanes_for(v, nl, g.dtype.is_float, True, False))
-        perm = K.lex_argsort(sort_lanes, live)
-        s_live = jnp.take(live, perm)
-        s_lanes = [jnp.take(l, perm) for l in flat_lanes]
-        s_nulls = [jnp.take(nl, perm) if nl is not None else None
-                   for nl in flat_nulls]
-        seg, start = K.group_segments(s_lanes, s_nulls, s_live)
-        num_groups = jnp.sum(start.astype(jnp.int32))
-    else:
-        # global aggregate: one group holding every live row; emit exactly one
-        # output row even over empty input (SQL: COUNT=0, SUM=NULL)
-        perm = jnp.arange(cap, dtype=jnp.int32)
-        s_live = live
-        seg = jnp.zeros((cap,), dtype=jnp.int32)
-        start = jnp.zeros((cap,), dtype=bool).at[0].set(True)
-        num_groups = jnp.int32(1)
+    if not groups:
+        return _global_aggregate(env, aggs, out_schema, live)
+
+    if seg_dims is not None and len(seg_dims) == len(groups):
+        return _direct_aggregate(env, groups, gvals, gnulls, aggs, out_schema,
+                                 live, seg_dims)
+
+    # sort path: equality lanes (string ids are already ranks; floats
+    # decompose into nan-flag + normalized-value lanes — no 64-bit bitcasts,
+    # TPU-safe)
+    flat_lanes: list = []
+    flat_nulls: list = []
+    sort_lanes: list = []
+    for v, nl, g in zip(gvals, gnulls, groups):
+        for lane in K.group_lanes_for(v, g.dtype.is_float):
+            flat_lanes.append(lane)
+            flat_nulls.append(nl)
+        sort_lanes.extend(K.sort_lanes_for(v, nl, g.dtype.is_float, True, False))
+    perm = K.lex_argsort(sort_lanes, live)
+    s_live = jnp.take(live, perm)
+    s_lanes = [jnp.take(l, perm) for l in flat_lanes]
+    s_nulls = [jnp.take(nl, perm) if nl is not None else None
+               for nl in flat_nulls]
+    seg, start = K.group_segments(s_lanes, s_nulls, s_live)
+    num_groups = jnp.sum(start.astype(jnp.int32))
 
     # first sorted row of each segment (for group representative values)
     pos = jnp.arange(cap, dtype=jnp.int32)
@@ -101,22 +129,30 @@ def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
 
     # aggregates via segment reductions over sorted order
     for spec in aggs:
-        out_cols.append(_reduce_one(spec, env, perm, seg, s_live, cap))
+        out_cols.append(_reduce_one(spec, env, perm, seg, s_live, cap, cap))
 
     out_live = jnp.arange(cap, dtype=jnp.int32) < num_groups
     return DeviceBatch(out_schema, out_cols, out_live)
 
 
-def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap) -> DeviceColumn:
+def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
+                nseg) -> DeviceColumn:
+    """Segment reduction for one aggregate. `perm` sorts rows into segment
+    order (None = rows already aligned with `seg`); output arrays have length
+    `nseg` (= cap on the sort path, the padded segment count on the direct
+    path)."""
     if spec.func is AggFunc.COUNT_STAR:
-        cnt = jax.ops.segment_sum(s_live.astype(jnp.int64), seg, num_segments=cap)
+        cnt = jax.ops.segment_sum(s_live.astype(jnp.int64), seg,
+                                  num_segments=nseg)
         return DeviceColumn(T.INT64, cnt, None, None)
 
     v, nl = spec.arg.fn(env)
-    sv = jnp.take(v, perm)
-    snl = jnp.take(nl, perm) if nl is not None else None
+    sv = v if perm is None else jnp.take(v, perm)
+    snl = nl if perm is None else (jnp.take(nl, perm)
+                                   if nl is not None else None)
     valid = s_live if snl is None else (s_live & ~snl)
-    n_valid = jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=cap)
+    n_valid = jax.ops.segment_sum(valid.astype(jnp.int64), seg,
+                                  num_segments=nseg)
     all_null = n_valid == 0
 
     if spec.func is AggFunc.COUNT:
@@ -126,7 +162,7 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap) -> DeviceColumn
         acc_dtype = jnp.float64 if (spec.out_dtype.is_float or
                                     spec.func is AggFunc.AVG) else jnp.int64
         sval = jnp.where(valid, sv.astype(acc_dtype), jnp.zeros((), acc_dtype))
-        total = jax.ops.segment_sum(sval, seg, num_segments=cap)
+        total = jax.ops.segment_sum(sval, seg, num_segments=nseg)
         if spec.func is AggFunc.AVG:
             denom = jnp.where(all_null, 1, n_valid).astype(jnp.float64)
             return DeviceColumn(T.FLOAT64, total / denom, all_null, None)
@@ -149,17 +185,135 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap) -> DeviceColumn
         hi = jnp.iinfo(jnp.int64).max
     if spec.func is AggFunc.MIN:
         keyed = jnp.where(valid, lane, hi)
-        best_lane = jax.ops.segment_min(keyed, seg, num_segments=cap)
+        best_lane = jax.ops.segment_min(keyed, seg, num_segments=nseg)
     else:
         keyed = jnp.where(valid, lane, lo)
-        best_lane = jax.ops.segment_max(keyed, seg, num_segments=cap)
+        best_lane = jax.ops.segment_max(keyed, seg, num_segments=nseg)
     # recover a row index holding the winning lane value for exact value gather
     is_best = valid & (keyed == jnp.take(best_lane, seg))
     best_pos = jax.ops.segment_min(jnp.where(is_best, pos, jnp.int32(cap)), seg,
-                                   num_segments=cap)
+                                   num_segments=nseg)
     best_pos = jnp.clip(best_pos, 0, cap - 1)
     out_val = jnp.take(sv, best_pos)
     return DeviceColumn(spec.out_dtype, out_val, all_null, spec.out_dict)
+
+
+def _global_aggregate(env: Env, aggs: list[AggSpec], out_schema: T.Schema,
+                      live: jax.Array) -> DeviceBatch:
+    """No GROUP BY: plain masked reductions — no segment scatter (the old path
+    scattered into `capacity` segments to produce ONE row, allocating and
+    reducing an input-sized output per aggregate; warm SF1 Q6 spent ~2.7s
+    there). Emits exactly one row even over empty input (SQL: COUNT=0,
+    SUM=NULL); output capacity MIN_CAPACITY."""
+    from igloo_tpu.exec.batch import MIN_CAPACITY
+
+    def one_row(scalar, dtype, is_null=None):
+        lane = jnp.zeros((MIN_CAPACITY,), dtype=dtype).at[0].set(
+            scalar.astype(dtype))
+        nl = None
+        if is_null is not None:
+            nl = jnp.zeros((MIN_CAPACITY,), dtype=bool).at[0].set(is_null)
+        return lane, nl
+
+    out_cols: list[DeviceColumn] = []
+    for spec in aggs:
+        if spec.func is AggFunc.COUNT_STAR:
+            lane, _ = one_row(jnp.sum(live.astype(jnp.int64)), jnp.int64)
+            out_cols.append(DeviceColumn(T.INT64, lane, None, None))
+            continue
+        v, nl = spec.arg.fn(env)
+        valid = live if nl is None else (live & ~nl)
+        n_valid = jnp.sum(valid.astype(jnp.int64))
+        all_null = n_valid == 0
+        if spec.func is AggFunc.COUNT:
+            lane, _ = one_row(n_valid, jnp.int64)
+            out_cols.append(DeviceColumn(T.INT64, lane, None, None))
+        elif spec.func in (AggFunc.SUM, AggFunc.AVG):
+            acc_dtype = jnp.float64 if (spec.out_dtype.is_float or
+                                        spec.func is AggFunc.AVG) else jnp.int64
+            total = jnp.sum(jnp.where(valid, v.astype(acc_dtype),
+                                      jnp.zeros((), acc_dtype)))
+            if spec.func is AggFunc.AVG:
+                denom = jnp.where(all_null, 1, n_valid).astype(jnp.float64)
+                lane, nlo = one_row(total / denom, jnp.float64, all_null)
+                out_cols.append(DeviceColumn(T.FLOAT64, lane, nlo, None))
+            else:
+                lane, nlo = one_row(total, spec.out_dtype.device_dtype(),
+                                    all_null)
+                out_cols.append(DeviceColumn(spec.out_dtype, lane, nlo, None))
+        else:  # MIN / MAX with exact winning-row gather (NaN stays NaN)
+            if spec.arg.dtype.is_float:
+                vnorm, nan = K.normalize_float(v)
+                lane_v = jnp.where(nan, jnp.asarray(jnp.inf, vnorm.dtype),
+                                   vnorm)
+                lo = jnp.asarray(-jnp.inf, lane_v.dtype)
+                hi = jnp.asarray(jnp.inf, lane_v.dtype)
+            else:
+                lane_v = v.astype(jnp.int64)
+                lo = jnp.iinfo(jnp.int64).min
+                hi = jnp.iinfo(jnp.int64).max
+            keyed = jnp.where(valid, lane_v,
+                              hi if spec.func is AggFunc.MIN else lo)
+            best = jnp.argmin(keyed) if spec.func is AggFunc.MIN \
+                else jnp.argmax(keyed)
+            lane, nlo = one_row(jnp.take(v, best),
+                                spec.out_dtype.device_dtype(), all_null)
+            out_cols.append(DeviceColumn(spec.out_dtype, lane, nlo,
+                                         spec.out_dict))
+    out_live = jnp.zeros((MIN_CAPACITY,), dtype=bool).at[0].set(True)
+    return DeviceBatch(out_schema, out_cols, out_live)
+
+
+def _direct_aggregate(env: Env, groups: list[Compiled], gvals, gnulls,
+                      aggs: list[AggSpec], out_schema: T.Schema,
+                      live: jax.Array,
+                      seg_dims: tuple[int, ...]) -> DeviceBatch:
+    """Direct-scatter grouping for small indexable keys (see seg_dims_for):
+    segment id = mixed-radix combination of (NULL?0:key+1) digits. Skips the
+    full-capacity lex sort; output capacity = padded segment count (small)."""
+    from igloo_tpu.exec.batch import round_capacity
+    cap = live.shape[0]
+    prod = 1
+    for d in seg_dims:
+        prod *= d
+    nseg = round_capacity(prod + 1)
+    dead = nseg - 1  # dead rows land here; >= prod, never a real key combo
+    seg = jnp.zeros((cap,), dtype=jnp.int32)
+    for v, nl, d in zip(gvals, gnulls, seg_dims):
+        comp = v.astype(jnp.int32) + 1
+        if nl is not None:
+            comp = jnp.where(nl, 0, comp)
+        seg = seg * jnp.int32(d) + comp
+    seg = jnp.where(live, seg, jnp.int32(dead))
+
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    counts = jax.ops.segment_sum(live.astype(jnp.int32), seg,
+                                 num_segments=nseg)
+    group_mask = (counts > 0) & (jnp.arange(nseg) < prod)
+    first_pos = jax.ops.segment_min(jnp.where(live, pos, jnp.int32(cap)), seg,
+                                    num_segments=nseg)
+    first_pos = jnp.clip(first_pos, 0, cap - 1)
+
+    out_cols: list[DeviceColumn] = []
+    for v, nl, g in zip(gvals, gnulls, groups):
+        sv = jnp.take(v, first_pos)
+        snl = jnp.take(nl, first_pos) if nl is not None else None
+        out_cols.append(DeviceColumn(g.dtype, sv.astype(g.dtype.device_dtype())
+                                     if sv.dtype != g.dtype.device_dtype() else sv,
+                                     snl, g.out_dict))
+    for spec in aggs:
+        out_cols.append(_reduce_one(spec, env, None, seg, live, cap, nseg))
+
+    # compact live groups to the front (segment-id order = NULL-first
+    # dictionary-rank order); aggregate output row order is not semantic
+    perm_small = K.compact_perm(group_mask)
+    n_groups = jnp.sum(group_mask.astype(jnp.int32))
+    out_cols = [DeviceColumn(c.dtype, jnp.take(c.values, perm_small),
+                             jnp.take(c.nulls, perm_small)
+                             if c.nulls is not None else None, c.dictionary)
+                for c in out_cols]
+    out_live = jnp.arange(nseg, dtype=jnp.int32) < n_groups
+    return DeviceBatch(out_schema, out_cols, out_live)
 
 
 def distinct_batch(batch: DeviceBatch) -> DeviceBatch:

@@ -27,7 +27,9 @@ import pyarrow as pa
 from igloo_tpu import types as T
 from igloo_tpu.errors import ExecError, NotSupportedError, PlanError
 from igloo_tpu.exec import kernels as K
-from igloo_tpu.exec.aggregate import AggSpec, aggregate_batch, distinct_batch
+from igloo_tpu.exec.aggregate import (
+    AggSpec, aggregate_batch, distinct_batch, seg_dims_for,
+)
 from igloo_tpu.exec.batch import (
     DeviceBatch, DeviceColumn, DictInfo, from_arrow, round_capacity, to_arrow,
 )
@@ -320,14 +322,18 @@ class Executor:
                 arg = None
             out_dict = arg.out_dict if (arg is not None and a.dtype.is_string) else None
             specs.append(AggSpec(a.func, arg, a.dtype, out_dict))
+        # direct-scatter eligibility is dictionary-CONTENT-dependent (sizes),
+        # so it must join the cache key, not just shape signatures
+        seg_dims = seg_dims_for(groups)
         fp = ("agg", expr_fingerprint(gres + ares),
               tuple((a.func, a.dtype) for a in aggs),
               batch_proto_key(batch), out_schema,
-              comp.pool.signature(), tuple(comp.marks))
+              comp.pool.signature(), tuple(comp.marks), seg_dims)
 
         def build():
             def fn(b: DeviceBatch, consts) -> DeviceBatch:
-                return aggregate_batch(b, groups, specs, out_schema, consts)
+                return aggregate_batch(b, groups, specs, out_schema, consts,
+                                       seg_dims=seg_dims)
             return fn
         out = self._jitted("agg", fp, build)(strip_dicts(batch),
                                              comp.pool.device_args())
@@ -337,57 +343,125 @@ class Executor:
 
     def _exec_distinct_aggregate(self, plan: L.Aggregate,
                                  batch: DeviceBatch) -> DeviceBatch:
-        """agg(DISTINCT x): dedupe on (group keys, x) first, then aggregate the
-        deduped arg. COUNT(*) mixed in is computed from a per-combination row
-        count carried through stage 1 (a COUNT_STAR over the deduped rows would
-        wrongly count distinct combinations). Mixing DISTINCT with other plain
-        aggregates (or multiple distinct arguments) would need per-agg branches
-        + a key join; not supported yet."""
+        """agg(DISTINCT x) mixed with arbitrary plain aggregates: stage 1
+        groups by (keys..., x), carrying per-combination PARTIALS of every
+        plain aggregate (COUNT_STAR -> row count, SUM -> partial sum, AVG ->
+        partial sum + count, MIN/MAX pass through); stage 2 re-groups by the
+        keys, applying the distinct aggregates to the deduped x column and
+        merging the plain partials. Only multiple DISTINCT arguments remain
+        unsupported (they would need a null-safe join of per-arg results)."""
         args = {repr(a.arg) for a in plan.aggs if a.distinct}
-        if len(args) > 1 or any(not a.distinct for a in plan.aggs
-                                if a.func is not E.AggFunc.COUNT_STAR):
+        if len(args) > 1:
             raise NotSupportedError(
-                "mixing DISTINCT aggregates with other aggregates (or multiple "
-                "distinct arguments) is not supported yet")
+                "multiple distinct aggregate arguments are not supported yet")
         d_arg = next(a.arg for a in plan.aggs if a.distinct)
         k = len(plan.group_exprs)
-        # stage 1: group by (keys..., arg) — one row per distinct combination,
-        # plus the number of input rows it covers
+        # stage 1: group by (keys..., arg); one row per distinct combination
         stage1_groups = list(plan.group_exprs) + [d_arg]
         names = [f"g{i}" for i in range(k)] + ["__arg"]
         s1_fields = [T.Field(n, g.dtype, True)
                      for n, g in zip(names, stage1_groups)]
-        s1_fields.append(T.Field("__cnt", T.INT64, False))
+        s1_aggs: list[E.Aggregate] = []
+        # per original plain agg: list of stage-1 column indices it reads
+        plain_slots: dict[int, tuple] = {}
+        si = k + 1  # stage-1 output: keys..., __arg, partial cols...
+
+        def s1_agg(func, arg, dtype):
+            nonlocal si
+            a2 = E.Aggregate(func=func, arg=arg, distinct=False)
+            a2.dtype = dtype
+            s1_aggs.append(a2)
+            s1_fields.append(T.Field(f"p{si}", dtype, True))
+            si += 1
+            return si - 1
+
+        for j, a in enumerate(plan.aggs):
+            if a.distinct:
+                continue
+            if a.func is E.AggFunc.COUNT_STAR:
+                plain_slots[j] = ("sum", s1_agg(E.AggFunc.COUNT_STAR, None,
+                                                T.INT64))
+            elif a.func is E.AggFunc.COUNT:
+                plain_slots[j] = ("sum", s1_agg(E.AggFunc.COUNT, a.arg,
+                                                T.INT64))
+            elif a.func is E.AggFunc.SUM:
+                plain_slots[j] = ("sum", s1_agg(E.AggFunc.SUM, a.arg, a.dtype))
+            elif a.func in (E.AggFunc.MIN, E.AggFunc.MAX):
+                plain_slots[j] = ("assoc", s1_agg(a.func, a.arg, a.dtype))
+            elif a.func is E.AggFunc.AVG:
+                plain_slots[j] = ("avg",
+                                  s1_agg(E.AggFunc.SUM, a.arg, T.FLOAT64),
+                                  s1_agg(E.AggFunc.COUNT, a.arg, T.INT64))
+            else:  # pragma: no cover - AggFunc is closed
+                raise NotSupportedError(f"distinct mix with {a.func}")
         s1_schema = T.Schema(s1_fields)
-        cnt = E.Aggregate(func=E.AggFunc.COUNT_STAR, arg=None, distinct=False)
-        cnt.dtype = T.INT64
-        deduped = self._aggregate(batch, stage1_groups, [cnt], s1_schema)
-        # stage 2: group by keys over the deduped rows, aggregates non-distinct
+        deduped = self._aggregate(batch, stage1_groups, s1_aggs, s1_schema)
+
+        # stage 2: group by keys over the deduped rows
         def rebased_col(i, dtype, name=None):
-            c = E.Column(name or names[i], index=i)
+            c = E.Column(name or f"c{i}", index=i)
             c.dtype = dtype
             return c
-        g2 = [rebased_col(i, g.dtype) for i, g in enumerate(plan.group_exprs)]
-        arg2 = rebased_col(k, d_arg.dtype)
-        cnt2 = rebased_col(k + 1, T.INT64, "__cnt")
-        aggs2 = []
-        for a in plan.aggs:
-            if a.func is E.AggFunc.COUNT_STAR:
-                n = E.Aggregate(func=E.AggFunc.SUM, arg=cnt2, distinct=False)
-            else:
-                n = E.Aggregate(func=a.func, arg=arg2, distinct=False)
-            n.dtype = a.dtype
-            aggs2.append(n)
-        out = self._aggregate(deduped, g2, aggs2, plan.schema)
-        # SUM over zero rows is NULL, but COUNT(*) must be 0 on empty input
+        g2 = [rebased_col(i, g.dtype, names[i])
+              for i, g in enumerate(plan.group_exprs)]
+        arg2 = rebased_col(k, d_arg.dtype, "__arg")
+        aggs2: list[E.Aggregate] = []
+        s2_fields = [T.Field(names[i], g.dtype, True)
+                     for i, g in enumerate(plan.group_exprs)]
+        # per original agg: stage-2 output column index (or (sum, cnt) pair)
+        out_slots: list = []
+        oi = k
+
+        def s2_agg(func, arg, dtype):
+            nonlocal oi
+            a2 = E.Aggregate(func=func, arg=arg, distinct=False)
+            a2.dtype = dtype
+            aggs2.append(a2)
+            s2_fields.append(T.Field(f"o{oi}", dtype, True))
+            oi += 1
+            return oi - 1
+
         for j, a in enumerate(plan.aggs):
-            if a.func is E.AggFunc.COUNT_STAR:
-                i = k + j
-                c = out.columns[i]
-                if c.nulls is not None:
-                    out.columns[i] = DeviceColumn(
-                        c.dtype, jnp.where(c.nulls, 0, c.values), None, None)
-        return out
+            if a.distinct:
+                out_slots.append(("direct", s2_agg(a.func, arg2, a.dtype)))
+                continue
+            kind = plain_slots[j][0]
+            if kind == "sum":
+                col = rebased_col(plain_slots[j][1],
+                                  s1_schema.fields[plain_slots[j][1]].dtype)
+                out_slots.append(("zero_null" if a.func in (
+                    E.AggFunc.COUNT, E.AggFunc.COUNT_STAR) else "direct",
+                    s2_agg(E.AggFunc.SUM, col, a.dtype)))
+            elif kind == "assoc":
+                col = rebased_col(plain_slots[j][1], a.dtype)
+                out_slots.append(("direct", s2_agg(a.func, col, a.dtype)))
+            else:  # avg: SUM(partial sums) / SUM(partial counts)
+                scol = rebased_col(plain_slots[j][1], T.FLOAT64)
+                ccol = rebased_col(plain_slots[j][2], T.INT64)
+                out_slots.append(("avg", s2_agg(E.AggFunc.SUM, scol, T.FLOAT64),
+                                  s2_agg(E.AggFunc.SUM, ccol, T.INT64)))
+        s2_schema = T.Schema(s2_fields)
+        merged = self._aggregate(deduped, g2, aggs2, s2_schema)
+
+        # final: pick/compute the plan's declared output columns
+        cols = list(merged.columns[:k])
+        for slot, a in zip(out_slots, plan.aggs):
+            if slot[0] == "avg":
+                s, c = merged.columns[slot[1]], merged.columns[slot[2]]
+                cnt_v = jnp.where(c.nulls, 0, c.values) if c.nulls is not None \
+                    else c.values
+                denom = jnp.where(cnt_v == 0, 1, cnt_v).astype(jnp.float64)
+                cols.append(DeviceColumn(
+                    T.FLOAT64, s.values.astype(jnp.float64) / denom,
+                    cnt_v == 0, None))
+            elif slot[0] == "zero_null":
+                c = merged.columns[slot[1]]
+                vals = jnp.where(c.nulls, 0, c.values) if c.nulls is not None \
+                    else c.values
+                cols.append(DeviceColumn(T.INT64, vals, None, None))
+            else:
+                cols.append(merged.columns[slot[1]])
+        return DeviceBatch(plan.schema, cols, merged.live)
 
     def _exec_distinct(self, plan: L.Distinct) -> DeviceBatch:
         batch = self._exec(plan.input)

@@ -257,7 +257,19 @@ class ShardedExecutor(Executor):
                 final_fields.append(T.Field(f"f{idx}", a.dtype, True))
         final_schema = T.Schema(final_fields)
 
+        from igloo_tpu.exec.aggregate import seg_dims_for
+        sdims = seg_dims_for(groups)
+        fdims = seg_dims_for(final_groups)
         local_cap = batch.capacity // n
+        # partial output capacity: direct-scatter partials are segment-count
+        # sized, so shuffle buckets and final capacities shrink with them
+        if sdims is not None:
+            p = 1
+            for d in sdims:
+                p *= d
+            partial_cap = round_capacity(p + 1)
+        else:
+            partial_cap = local_cap
         if k == 0:
             # global aggregate: one partial row per shard -> all_gather -> final
             def local_fn(b, consts):
@@ -281,8 +293,8 @@ class ShardedExecutor(Executor):
                                self._agg_out_dicts(aggs, compiled_args))
             return out
 
-        bucket = (default_bucket_cap(local_cap, n) if self._speculate
-                  else local_cap)
+        bucket = (default_bucket_cap(partial_cap, n) if self._speculate
+                  else partial_cap)
         if self._speculate:
             # ~uniform share of groups with 2x skew headroom; overflow flag
             # triggers an exact re-run
@@ -295,11 +307,11 @@ class ShardedExecutor(Executor):
 
         def local_fn(b, consts):
             partial = aggregate_batch(b, groups, partial_specs, partial_schema,
-                                      consts)
+                                      consts, seg_dims=sdims)
             dest = self._group_dest(partial, k, n)
             shuffled, ovf1 = shuffle_batch_local(partial, dest, n, bucket, ROWS)
             final = aggregate_batch(shuffled, final_groups, final_specs,
-                                    final_schema, ())
+                                    final_schema, (), seg_dims=fdims)
             out = self._fixup_final(final, final_plan, k, out_schema)
             # bound the output capacity (speculative: overflow -> exact re-run)
             perm = K.compact_perm(out.live)
@@ -314,7 +326,7 @@ class ShardedExecutor(Executor):
               tuple((a.func, a.dtype) for a in aggs),
               batch_proto_key(batch), out_schema,
               comp.pool.signature(), tuple(comp.marks), n, bucket,
-              out_cap_local)
+              out_cap_local, sdims, fdims)
         out, overflow = self._jitted_shard_map(
             "shagg", fp, local_fn, out_specs=(P(ROWS), P()))(
             strip_dicts(batch), comp.pool.device_args())

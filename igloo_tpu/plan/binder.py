@@ -132,6 +132,9 @@ class Binder:
         self.udfs = udfs or {}
         self._cte_env: dict[str, L.LogicalPlan] = {}
         self._anon = 0
+        # correlated scalar subqueries are only decorrelatable where the
+        # caller can rewrite the surrounding plan (WHERE conjuncts)
+        self._allow_corr_scalar = False
 
     # --- entry point ---
 
@@ -606,7 +609,16 @@ class Binder:
                 plan = self._rewrite_exists(
                     inner, plan, scope, anti=(neg != inner.negated))
             else:
-                preds.append(self.bind_expr(c, scope, plan))
+                saved_flag = self._allow_corr_scalar
+                self._allow_corr_scalar = True
+                try:
+                    p = self.bind_expr(c, scope, plan)
+                finally:
+                    self._allow_corr_scalar = saved_flag
+                if _contains_corr_scalar(p):
+                    plan = self._apply_corr_scalar(plan, p)
+                else:
+                    preds.append(p)
         for p in preds:
             if p.dtype != T.BOOL:
                 raise PlanError(f"WHERE predicate must be boolean, got {p.dtype}")
@@ -617,7 +629,7 @@ class Binder:
         if len(sub.schema) != 1:
             raise PlanError("IN subquery must return exactly one column")
         probe = self.bind_expr(node.operand, scope, plan)
-        sub, corr_l, corr_r = self._decorrelate(sub, plan.schema)
+        sub, corr_l, corr_r, _ = self._decorrelate(sub, plan.schema)
         key_r = E.Column(sub.schema.fields[0].name, index=0)
         key_r.dtype = sub.schema.fields[0].dtype
         probe, key_r = coerce_key_pair(probe, key_r)
@@ -651,9 +663,16 @@ class Binder:
 
     def _rewrite_exists(self, node: E.Exists, plan, scope, anti: bool):
         sub = self.bind_query(node.query, scope)
-        sub, corr_l, corr_r = self._decorrelate(sub, plan.schema)
+        sub, corr_l, corr_r, residual = self._decorrelate(
+            sub, plan.schema, allow_residual=True)
         corr_l, corr_r = _coerce_key_lists(corr_l, corr_r)
         if not corr_l:
+            if residual is not None:
+                # pure non-equi correlation: the __one projection below would
+                # invalidate the residual's inner column indices
+                raise NotSupportedError(
+                    "EXISTS correlated only through non-equality predicates "
+                    "is not supported yet")
             # uncorrelated EXISTS: degenerate — keep all or no rows; model as
             # cross-semi on constant key
             one = E.Literal(value=1, literal_type=T.INT32)
@@ -669,17 +688,145 @@ class Binder:
             corr_r[0].dtype = T.INT32
         j = L.Join(left=plan, right=sub,
                    join_type=A.JoinType.ANTI if anti else A.JoinType.SEMI,
-                   left_keys=corr_l, right_keys=corr_r)
+                   left_keys=corr_l, right_keys=corr_r, residual=residual)
         j.schema = plan.schema
         return j
 
-    def _decorrelate(self, sub: L.LogicalPlan, outer_schema):
+    def _apply_corr_scalar(self, plan: L.LogicalPlan,
+                           pred: E.Expr) -> L.LogicalPlan:
+        """WHERE conjunct containing correlated scalar aggregate subqueries
+        (q2/q17/q20 shape: `x CMP (SELECT agg(...) FROM t WHERE t.k = o.k)`).
+        Each subquery becomes a group-by-correlation-keys aggregate LEFT-joined
+        to the plan; the conjunct is filtered on top and the original columns
+        are projected back (no-match rows carry NULL -> comparison fails, the
+        SQL semantics of a scalar subquery over an empty set)."""
+        orig_schema = plan.schema
+        while True:
+            node = next((n for n in E.walk(pred)
+                         if isinstance(n, E.ScalarSubquery)
+                         and _plan_has_outer(n.query)), None)
+            if node is None:
+                break
+            plan, col = self._join_corr_scalar(plan, node.query)
+            # transform shallow-copies nodes, so match on the shared bound
+            # plan object rather than node identity
+            target = node.query
+            pred = E.transform(
+                pred, lambda n: col if isinstance(n, E.ScalarSubquery)
+                and n.query is target else n)
+        f = L.Filter(input=plan, predicate=pred)
+        f.schema = plan.schema
+        exprs = []
+        for i, fld in enumerate(orig_schema):
+            c = E.Column(fld.name, index=i)
+            c.dtype = fld.dtype
+            exprs.append(c)
+        pr = L.Project(input=f, exprs=exprs, names=list(orig_schema.names))
+        pr.schema = T.Schema(list(orig_schema.fields))
+        return pr
+
+    def _join_corr_scalar(self, plan: L.LogicalPlan, sub: L.LogicalPlan):
+        """-> (LEFT-joined plan, bound column for the subquery's value)."""
+        proj: Optional[L.Project] = None
+        node = sub
+        if isinstance(node, L.Project):
+            proj, node = node, node.input
+        if not isinstance(node, L.Aggregate) or node.group_exprs:
+            raise NotSupportedError(
+                "correlated scalar subquery must be a single ungrouped "
+                "aggregate")
+        inp, outer_keys, inner_cols, residual = self._decorrelate(
+            node.input, plan.schema)
+        if residual is not None or not outer_keys:
+            raise NotSupportedError(
+                "correlated scalar subquery needs equality correlation")
+        k = len(inner_cols)
+        agg = L.Aggregate(input=inp, group_exprs=inner_cols,
+                          group_names=[f"__ck{i}" for i in range(k)],
+                          aggs=list(node.aggs),
+                          agg_names=list(node.agg_names))
+        agg.schema = T.Schema(
+            [T.Field(f"__ck{i}", c.dtype, True)
+             for i, c in enumerate(inner_cols)] + list(node.schema.fields))
+        # value projection on top of the grouped aggregate: the original
+        # projection's column refs shift by k (group keys now lead). A second
+        # "empty-set" variant substitutes each aggregate with its value over
+        # zero rows (COUNT -> 0, others -> NULL): an ungrouped scalar subquery
+        # always returns one row, so no-match outer rows must see THAT value,
+        # not plain NULL (e.g. `(SELECT count(*) ...) = 0` keeps them).
+        count_idx = {i for i, a in enumerate(node.aggs)
+                     if a.func in (E.AggFunc.COUNT, E.AggFunc.COUNT_STAR)}
+
+        def shift(n):
+            if isinstance(n, E.Column):
+                c = E.Column(n.name, index=n.index + k)
+                c.dtype = n.dtype
+                return c
+            return n
+
+        def on_empty(n):
+            if isinstance(n, E.Column):
+                if n.index in count_idx:
+                    zero = E.Literal(value=0, literal_type=T.INT64)
+                    zero.dtype = n.dtype or T.INT64
+                    return zero
+                nul = E.Literal(value=None, literal_type=n.dtype)
+                nul.dtype = n.dtype
+                return nul
+            return n
+        if proj is not None:
+            vexpr = E.transform(copy.deepcopy(proj.exprs[0]), shift)
+            empty_expr = E.transform(copy.deepcopy(proj.exprs[0]), on_empty)
+        else:
+            c0 = E.Column(node.schema.fields[0].name, index=0)
+            c0.dtype = node.schema.fields[0].dtype
+            vexpr = shift(c0)
+            empty_expr = on_empty(c0)
+        present = E.Literal(value=True, literal_type=T.BOOL)
+        present.dtype = T.BOOL
+        exprs = [vexpr, present]
+        names = ["__sv", "__pv"]
+        for i, c in enumerate(inner_cols):
+            kc = E.Column(f"__ck{i}", index=i)
+            kc.dtype = c.dtype
+            exprs.append(kc)
+            names.append(f"__ck{i}")
+        pr = L.Project(input=agg, exprs=exprs, names=names)
+        pr.schema = T.Schema([T.Field(n, e.dtype, True)
+                              for n, e in zip(names, exprs)])
+        right_keys = []
+        for i, c in enumerate(inner_cols):
+            rc = E.Column(f"__ck{i}", index=2 + i)
+            rc.dtype = c.dtype
+            right_keys.append(rc)
+        outer_keys, right_keys = _coerce_key_lists(outer_keys, right_keys)
+        j = L.Join(left=plan, right=pr, join_type=A.JoinType.LEFT,
+                   left_keys=outer_keys, right_keys=right_keys)
+        j.schema = T.Schema(list(plan.schema.fields) + list(pr.schema.fields))
+        n_left = len(plan.schema)
+        sv = E.Column("__sv", index=n_left)
+        sv.dtype = vexpr.dtype
+        pv = E.Column("__pv", index=n_left + 1)
+        pv.dtype = T.BOOL
+        miss = E.IsNull(operand=pv)
+        miss.dtype = T.BOOL
+        val = E.Case(whens=[(miss, empty_expr)], else_=sv)
+        val.dtype = vexpr.dtype
+        return j, val
+
+    def _decorrelate(self, sub: L.LogicalPlan, outer_schema,
+                     allow_residual: bool = False):
         """Pull correlated equality predicates (OuterRef = inner_col) out of the
-        subquery plan, returning (rewritten_sub, outer_keys, inner_key_cols).
-        Inner key columns are appended to the subquery output; each stripped
-        predicate remembers the schema its inner side was bound against so the
-        keys are attached at a projection with a MATCHING input schema."""
+        subquery plan, returning (rewritten_sub, outer_keys, inner_key_cols,
+        residual). Inner key columns are appended to the subquery output; each
+        stripped predicate remembers the schema its inner side was bound
+        against so the keys are attached at a projection with a MATCHING input
+        schema. With `allow_residual`, NON-equality correlated conjuncts (e.g.
+        q21's l2.l_suppkey <> l1.l_suppkey) are also stripped and returned as
+        one predicate re-based against concat(outer, inner) — the caller
+        attaches it as the join residual."""
         corr: list[tuple[ScopeEntry, E.Expr, T.Schema]] = []
+        residuals: list[tuple[E.Expr, T.Schema]] = []
 
         def strip(plan: L.LogicalPlan) -> L.LogicalPlan:
             if isinstance(plan, L.Filter):
@@ -690,6 +837,11 @@ class Binder:
                         corr.append((pair[0], pair[1], plan.input.schema))
                     else:
                         if any(isinstance(n, OuterRef) for n in E.walk(c)):
+                            if allow_residual and all(
+                                    n.level == 1 for n in E.walk(c)
+                                    if isinstance(n, OuterRef)):
+                                residuals.append((c, plan.input.schema))
+                                continue
                             raise NotSupportedError(
                                 f"unsupported correlated predicate: {c!r}")
                         kept.append(c)
@@ -711,24 +863,40 @@ class Binder:
                         for ex in _plan_exprs(p) for n in E.walk(ex))
         if has_outer:
             raise NotSupportedError("correlated reference outside WHERE equality")
+
+        # every inner expression the join must see: the corr key exprs, plus
+        # each inner column a residual conjunct references (appended the same
+        # way, so e.g. q21's `l2.l_suppkey <> l1.l_suppkey` survives the
+        # SELECT-1 projection)
+        res_slots: dict[int, int] = {}  # inner col index -> appended slot
+        extra: list[tuple[E.Expr, T.Schema]] = [
+            (ie, sc) for _, ie, sc in corr]
+        for c, sc in residuals:
+            for ncol in E.walk(c):
+                if isinstance(ncol, E.Column) and ncol.index not in res_slots:
+                    res_slots[ncol.index] = len(extra)
+                    cc = copy.deepcopy(ncol)
+                    extra.append((cc, sc))
+
         outer_keys, inner_cols = [], []
-        if corr:
+        base_n = len(sub.schema)
+        if extra:
             for outer_entry, _, _ in corr:
                 oc = E.Column(outer_entry.name, index=outer_entry.index)
                 oc.dtype = outer_entry.dtype
                 outer_keys.append(oc)
             if isinstance(sub, L.Project) and all(
-                    sc == sub.input.schema for _, _, sc in corr):
+                    sc == sub.input.schema for _, sc in extra):
                 # extend the subquery's own projection: the stripped predicates
                 # were bound against exactly its input schema
                 base_n = len(sub.exprs)
-                for k, (_, inner_expr, _) in enumerate(corr):
-                    sub.exprs.append(inner_expr)
+                for k, (ie, _) in enumerate(extra):
+                    sub.exprs.append(ie)
                     sub.names.append(f"__corr_{k}")
                 sub.schema = T.Schema(list(sub.schema.fields) + [
                     T.Field(f"__corr_{k}", ie.dtype, True)
-                    for k, (_, ie, _) in enumerate(corr)])
-            elif all(sc == sub.schema for _, _, sc in corr):
+                    for k, (ie, _) in enumerate(extra)])
+            elif all(sc == sub.schema for _, sc in extra):
                 # keys bound against the subquery output itself: wrap once
                 exprs, names = [], []
                 for i, f in enumerate(sub.schema):
@@ -737,8 +905,8 @@ class Binder:
                     exprs.append(c)
                     names.append(f.name)
                 base_n = len(exprs)
-                for k, (_, inner_expr, _) in enumerate(corr):
-                    exprs.append(inner_expr)
+                for k, (ie, _) in enumerate(extra):
+                    exprs.append(ie)
                     names.append(f"__corr_{k}")
                 pr = L.Project(input=sub, exprs=exprs, names=names)
                 pr.schema = T.Schema([T.Field(n, ex.dtype, True)
@@ -748,11 +916,29 @@ class Binder:
                 raise NotSupportedError(
                     "correlated predicate below a schema-changing operator "
                     "(aggregate/join) is not supported yet")
-            for k, (_, inner_expr, _) in enumerate(corr):
+            for k in range(len(corr)):
                 ic = E.Column(f"__corr_{k}", index=base_n + k)
-                ic.dtype = inner_expr.dtype
+                ic.dtype = extra[k][0].dtype
                 inner_cols.append(ic)
-        return sub, outer_keys, inner_cols
+
+        # re-base residual conjuncts against concat(outer, sub_output)
+        residual = None
+        if residuals:
+            n_outer = len(outer_schema)
+
+            def rebase(n):
+                if isinstance(n, OuterRef):
+                    c = E.Column(n.entry.name, index=n.entry.index)
+                    c.dtype = n.entry.dtype
+                    return c
+                if isinstance(n, E.Column):
+                    c = E.Column(n.name,
+                                 index=n_outer + base_n + res_slots[n.index])
+                    c.dtype = n.dtype
+                    return c
+                return n
+            residual = _and_all([E.transform(c, rebase) for c, _ in residuals])
+        return sub, outer_keys, inner_cols, residual
 
     # --- aggregates ---
 
@@ -980,10 +1166,12 @@ class Binder:
             raise PlanError("scalar subquery must return exactly one column")
         has_outer = any(isinstance(n, OuterRef) for p in L.walk_plan(sub)
                         for ex in _plan_exprs(p) for n in E.walk(ex))
-        if has_outer:
+        if has_outer and not self._allow_corr_scalar:
+            # only WHERE conjuncts have the group-by + join decorrelation
+            # (_apply_corr_scalar); anywhere else the OuterRefs would leak to
+            # the executor
             raise NotSupportedError(
-                "correlated scalar subqueries are rewritten by the planner; "
-                "this pattern is not yet supported")
+                "correlated scalar subqueries are only supported in WHERE")
         n = E.ScalarSubquery(query=sub)  # query now holds the BOUND PLAN
         n.dtype = sub.schema.fields[0].dtype
         return n
@@ -1153,6 +1341,17 @@ def _extract_equi_key(c: E.Expr, n_left: int):
         if isinstance(n, E.Column):
             n.index -= n_left
     return lk, rk
+
+
+def _plan_has_outer(plan) -> bool:
+    return isinstance(plan, L.LogicalPlan) and any(
+        isinstance(n, OuterRef) for p in L.walk_plan(plan)
+        for ex in _plan_exprs(p) for n in E.walk(ex))
+
+
+def _contains_corr_scalar(e: E.Expr) -> bool:
+    return any(isinstance(n, E.ScalarSubquery) and _plan_has_outer(n.query)
+               for n in E.walk(e))
 
 
 def _extract_corr_eq(c: E.Expr):

@@ -206,3 +206,245 @@ class TestTpch:
               j.p_size.between(1, 15)))
         want = _rev(j[m]).sum()
         np.testing.assert_allclose(got["revenue"], [want], rtol=1e-9)
+
+    def test_q2(self, env):
+        engine, dfs = env
+        got = run(engine, "q2")
+        p, s, ps = dfs["part"], dfs["supplier"], dfs["partsupp"]
+        n, r = dfs["nation"], dfs["region"]
+        eu = n.merge(r[r.r_name == "EUROPE"], left_on="n_regionkey",
+                     right_on="r_regionkey")
+        sj = s.merge(eu, left_on="s_nationkey", right_on="n_nationkey")
+        j = (ps.merge(sj, left_on="ps_suppkey", right_on="s_suppkey")
+             .merge(p[(p.p_size == 15) & p.p_type.str.endswith("BRASS")],
+                    left_on="ps_partkey", right_on="p_partkey"))
+        mins = j.groupby("p_partkey").ps_supplycost.transform("min")
+        w = j[j.ps_supplycost == mins].sort_values(
+            ["s_acctbal", "n_name", "s_name", "p_partkey"],
+            ascending=[False, True, True, True]).head(100)
+        assert got["p_partkey"].tolist() == w["p_partkey"].tolist()
+        np.testing.assert_allclose(got["s_acctbal"], w["s_acctbal"], rtol=1e-9)
+        assert got["s_name"].tolist() == w["s_name"].tolist()
+
+    def test_q7(self, env):
+        engine, dfs = env
+        got = run(engine, "q7")
+        li, o, c, s, n = (dfs["lineitem"], dfs["orders"], dfs["customer"],
+                          dfs["supplier"], dfs["nation"])
+        j = (li.merge(s[["s_suppkey", "s_nationkey"]], left_on="l_suppkey",
+                      right_on="s_suppkey")
+             .merge(o[["o_orderkey", "o_custkey"]], left_on="l_orderkey",
+                    right_on="o_orderkey")
+             .merge(c[["c_custkey", "c_nationkey"]], left_on="o_custkey",
+                    right_on="c_custkey")
+             .merge(n.rename(columns={"n_name": "supp_nation"})[
+                 ["n_nationkey", "supp_nation"]],
+                 left_on="s_nationkey", right_on="n_nationkey")
+             .merge(n.rename(columns={"n_name": "cust_nation"})[
+                 ["n_nationkey", "cust_nation"]],
+                 left_on="c_nationkey", right_on="n_nationkey",
+                 suffixes=("", "_c")))
+        j = j[((j.supp_nation == "FRANCE") & (j.cust_nation == "GERMANY")) |
+              ((j.supp_nation == "GERMANY") & (j.cust_nation == "FRANCE"))]
+        j = j[(j.l_shipdate >= _d(1995, 1, 1)) &
+              (j.l_shipdate <= _d(1996, 12, 31))]
+        j = j.assign(l_year=[d.year for d in j.l_shipdate], volume=_rev(j))
+        w = j.groupby(["supp_nation", "cust_nation", "l_year"],
+                      as_index=False).volume.sum().sort_values(
+            ["supp_nation", "cust_nation", "l_year"])
+        assert got["supp_nation"].tolist() == w["supp_nation"].tolist()
+        assert got["l_year"].tolist() == w["l_year"].tolist()
+        np.testing.assert_allclose(got["revenue"], w["volume"], rtol=1e-9)
+
+    def test_q8(self, env):
+        engine, dfs = env
+        got = run(engine, "q8")
+        li, o, c, s, n, r, p = (dfs["lineitem"], dfs["orders"],
+                                dfs["customer"], dfs["supplier"],
+                                dfs["nation"], dfs["region"], dfs["part"])
+        j = (li.merge(p[p.p_type == "ECONOMY ANODIZED STEEL"][["p_partkey"]],
+                      left_on="l_partkey", right_on="p_partkey")
+             .merge(s[["s_suppkey", "s_nationkey"]], left_on="l_suppkey",
+                    right_on="s_suppkey")
+             .merge(o[["o_orderkey", "o_custkey", "o_orderdate"]],
+                    left_on="l_orderkey", right_on="o_orderkey")
+             .merge(c[["c_custkey", "c_nationkey"]], left_on="o_custkey",
+                    right_on="c_custkey"))
+        am = n.merge(r[r.r_name == "AMERICA"], left_on="n_regionkey",
+                     right_on="r_regionkey")[["n_nationkey"]]
+        j = j.merge(am, left_on="c_nationkey", right_on="n_nationkey")
+        j = j.merge(n[["n_nationkey", "n_name"]], left_on="s_nationkey",
+                    right_on="n_nationkey", suffixes=("", "_s"))
+        j = j[(j.o_orderdate >= _d(1995, 1, 1)) &
+              (j.o_orderdate <= _d(1996, 12, 31))]
+        j = j.assign(o_year=[d.year for d in j.o_orderdate], volume=_rev(j))
+        if len(j) == 0:
+            assert got.empty
+            return
+        g = j.groupby("o_year").apply(
+            lambda d: d[d.n_name == "BRAZIL"].volume.sum() / d.volume.sum(),
+            include_groups=False).reset_index(name="mkt_share") \
+            .sort_values("o_year")
+        assert got["o_year"].tolist() == g["o_year"].tolist()
+        np.testing.assert_allclose(got["mkt_share"], g["mkt_share"], rtol=1e-9)
+
+    def test_q9(self, env):
+        engine, dfs = env
+        got = run(engine, "q9")
+        li, s, ps, o, n, p = (dfs["lineitem"], dfs["supplier"],
+                              dfs["partsupp"], dfs["orders"], dfs["nation"],
+                              dfs["part"])
+        j = (li.merge(p[p.p_name.str.contains("green")][["p_partkey"]],
+                      left_on="l_partkey", right_on="p_partkey")
+             .merge(s[["s_suppkey", "s_nationkey"]], left_on="l_suppkey",
+                    right_on="s_suppkey")
+             .merge(ps[["ps_partkey", "ps_suppkey", "ps_supplycost"]],
+                    left_on=["l_partkey", "l_suppkey"],
+                    right_on=["ps_partkey", "ps_suppkey"])
+             .merge(o[["o_orderkey", "o_orderdate"]], left_on="l_orderkey",
+                    right_on="o_orderkey")
+             .merge(n[["n_nationkey", "n_name"]], left_on="s_nationkey",
+                    right_on="n_nationkey"))
+        assert len(j) > 0, "generator must produce green parts"
+        j = j.assign(o_year=[d.year for d in j.o_orderdate],
+                     amount=_rev(j) - j.ps_supplycost * j.l_quantity)
+        w = j.groupby(["n_name", "o_year"], as_index=False).amount.sum() \
+            .sort_values(["n_name", "o_year"], ascending=[True, False])
+        assert got["nation"].tolist() == w["n_name"].tolist()
+        assert got["o_year"].tolist() == w["o_year"].tolist()
+        np.testing.assert_allclose(got["sum_profit"], w["amount"], rtol=1e-9)
+
+    def test_q11(self, env):
+        engine, dfs = env
+        got = run(engine, "q11")
+        ps, s, n = dfs["partsupp"], dfs["supplier"], dfs["nation"]
+        de = s.merge(n[n.n_name == "GERMANY"], left_on="s_nationkey",
+                     right_on="n_nationkey")[["s_suppkey"]]
+        j = ps.merge(de, left_on="ps_suppkey", right_on="s_suppkey")
+        j = j.assign(v=j.ps_supplycost * j.ps_availqty)
+        g = j.groupby("ps_partkey", as_index=False).v.sum()
+        thresh = j.v.sum() * 0.0001
+        w = g[g.v > thresh].sort_values("v", ascending=False)
+        assert got["ps_partkey"].tolist() == w["ps_partkey"].tolist()
+        np.testing.assert_allclose(got["value"], w["v"], rtol=1e-9)
+
+    def test_q13(self, env):
+        engine, dfs = env
+        got = run(engine, "q13")
+        c, o = dfs["customer"], dfs["orders"]
+        o2 = o[~o.o_comment.str.contains("special.*requests", regex=True)]
+        j = c[["c_custkey"]].merge(o2[["o_custkey", "o_orderkey"]],
+                                   left_on="c_custkey", right_on="o_custkey",
+                                   how="left")
+        cc = j.groupby("c_custkey").o_orderkey.count().reset_index(
+            name="c_count")
+        w = cc.groupby("c_count").size().reset_index(name="custdist") \
+            .sort_values(["custdist", "c_count"], ascending=[False, False])
+        # zero-order customers must exist (generator skips custkey % 3 == 0)
+        assert (w.c_count == 0).any()
+        assert got["c_count"].tolist() == w["c_count"].tolist()
+        assert got["custdist"].tolist() == w["custdist"].tolist()
+
+    def test_q15(self, env):
+        engine, dfs = env
+        got = run(engine, "q15")
+        li, s = dfs["lineitem"], dfs["supplier"]
+        d = li[(li.l_shipdate >= _d(1996, 1, 1)) &
+               (li.l_shipdate < _d(1996, 4, 1))]
+        rev = d.assign(r=_rev(d)).groupby("l_suppkey", as_index=False).r.sum()
+        top = rev[rev.r == rev.r.max()]
+        w = s.merge(top, left_on="s_suppkey", right_on="l_suppkey") \
+            .sort_values("s_suppkey")
+        assert got["s_suppkey"].tolist() == w["s_suppkey"].tolist()
+        np.testing.assert_allclose(got["total_revenue"], w["r"], rtol=1e-9)
+
+    def test_q17(self, env):
+        engine, dfs = env
+        got = run(engine, "q17")
+        li, p = dfs["lineitem"], dfs["part"]
+        sel = p[(p.p_brand == "Brand#23") & (p.p_container == "MED BOX")]
+        j = li.merge(sel[["p_partkey"]], left_on="l_partkey",
+                     right_on="p_partkey")
+        avgq = li.groupby("l_partkey").l_quantity.mean()
+        j = j[j.l_quantity < 0.2 * j.l_partkey.map(avgq)]
+        want = j.l_extendedprice.sum() / 7.0
+        if len(j) == 0:
+            assert got["avg_yearly"].isna().all() or \
+                (got["avg_yearly"] == 0).all()
+        else:
+            np.testing.assert_allclose(got["avg_yearly"], [want], rtol=1e-9)
+
+    def test_q20(self, env):
+        engine, dfs = env
+        got = run(engine, "q20")
+        li, s, ps, p, n = (dfs["lineitem"], dfs["supplier"], dfs["partsupp"],
+                           dfs["part"], dfs["nation"])
+        fparts = p[p.p_name.str.startswith("forest")][["p_partkey"]]
+        shipped = li[(li.l_shipdate >= _d(1994, 1, 1)) &
+                     (li.l_shipdate < _d(1995, 1, 1))]
+        qty = shipped.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum()
+        cand = ps.merge(fparts, left_on="ps_partkey", right_on="p_partkey")
+        key = list(zip(cand.ps_partkey, cand.ps_suppkey))
+        half = [0.5 * qty.get(k, float("nan")) for k in key]
+        cand = cand.assign(half=half)
+        cand = cand[cand.ps_availqty > cand.half]
+        ca = n[n.n_name == "CANADA"][["n_nationkey"]]
+        sj = s.merge(ca, left_on="s_nationkey", right_on="n_nationkey")
+        w = sj[sj.s_suppkey.isin(set(cand.ps_suppkey))].sort_values("s_name")
+        assert got["s_name"].tolist() == w["s_name"].tolist()
+
+    def test_q21(self, env):
+        engine, dfs = env
+        li, s, o, n = (dfs["lineitem"], dfs["supplier"], dfs["orders"],
+                       dfs["nation"])
+        # the tiny-SF supplier table may miss SAUDI ARABIA entirely; run the
+        # same query against the best-populated nation so the EXISTS/NOT
+        # EXISTS path is exercised on real rows
+        counts = s.merge(n, left_on="s_nationkey", right_on="n_nationkey") \
+            .groupby("n_name").size()
+        nation = counts.idxmax()
+        got = engine.execute(
+            QUERIES["q21"].replace("SAUDI ARABIA", nation)).to_pandas()
+        sa = s.merge(n[n.n_name == nation], left_on="s_nationkey",
+                     right_on="n_nationkey")
+        l1 = li[li.l_receiptdate > li.l_commitdate]
+        l1 = l1.merge(o[o.o_orderstatus == "F"][["o_orderkey"]],
+                      left_on="l_orderkey", right_on="o_orderkey")
+        l1 = l1.merge(sa[["s_suppkey", "s_name"]], left_on="l_suppkey",
+                      right_on="s_suppkey")
+        multi = li.groupby("l_orderkey").l_suppkey.nunique()
+        late = li[li.l_receiptdate > li.l_commitdate] \
+            .groupby("l_orderkey").l_suppkey.nunique()
+
+        def keeps(row):
+            ok = row.l_orderkey
+            others = multi.get(ok, 1) > 1
+            # no OTHER supplier was late on this order
+            n_late = late.get(ok, 0)
+            only_me_late = n_late == 1
+            return others and only_me_late
+        l1 = l1[np.array([keeps(r) for r in l1.itertuples()], dtype=bool)]
+        w = l1.groupby("s_name").size().reset_index(name="numwait") \
+            .sort_values(["numwait", "s_name"], ascending=[False, True]) \
+            .head(100)
+        assert got["s_name"].tolist() == w["s_name"].tolist()
+        assert got["numwait"].tolist() == w["numwait"].tolist()
+
+    def test_q22(self, env):
+        engine, dfs = env
+        got = run(engine, "q22")
+        c, o = dfs["customer"], dfs["orders"]
+        codes = {"13", "31", "23", "29", "30", "18", "17"}
+        cc = c.assign(code=c.c_phone.str[:2])
+        pool = cc[cc.code.isin(codes)]
+        avg = pool[pool.c_acctbal > 0].c_acctbal.mean()
+        sel = pool[(pool.c_acctbal > avg) &
+                   ~pool.c_custkey.isin(set(o.o_custkey))]
+        assert len(sel) > 0, "generator must leave some customers orderless"
+        w = sel.groupby("code").agg(numcust=("c_custkey", "size"),
+                                    totacctbal=("c_acctbal", "sum")) \
+            .reset_index().sort_values("code")
+        assert got["cntrycode"].tolist() == w["code"].tolist()
+        assert got["numcust"].tolist() == w["numcust"].tolist()
+        np.testing.assert_allclose(got["totacctbal"], w["totacctbal"],
+                                   rtol=1e-9)
