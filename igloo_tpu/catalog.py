@@ -71,6 +71,9 @@ class MemTable:
             t = t.select(projection)
         return t
 
+    def estimated_bytes(self) -> int:
+        return self._table.nbytes
+
 
 class Catalog:
     """Thread-safe name -> provider registry (the coordinator serves one per

@@ -61,6 +61,10 @@ class CsvTable:
     def schema(self) -> Schema:
         return self._schema
 
+    def estimated_bytes(self):
+        from igloo_tpu.connectors.parquet import files_bytes
+        return files_bytes(self._files)
+
     def num_partitions(self) -> int:
         return len(self._files)
 

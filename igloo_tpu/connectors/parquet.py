@@ -77,6 +77,9 @@ class ParquetTable:
     def num_partitions(self) -> int:
         return len(self._partition_index())
 
+    def estimated_bytes(self) -> Optional[int]:
+        return files_bytes(self._files)
+
     def read(self, projection: Optional[list[str]] = None,
              filters: Optional[list] = None) -> pa.Table:
         tables = [self._read_file(f, projection, filters) for f in self._files]
@@ -114,6 +117,14 @@ class ParquetTable:
             raise
         except Exception as ex:
             raise ConnectorError(f"parquet read failed for {path}: {ex}") from None
+
+
+def files_bytes(files: list[str]) -> Optional[int]:
+    """Total on-disk size of a connector's files (chunked-execution sizing)."""
+    try:
+        return sum(os.path.getsize(f) for f in files)
+    except OSError:
+        return None
 
 
 def file_snapshot(files: list[str]) -> tuple:

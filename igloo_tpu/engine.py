@@ -53,12 +53,16 @@ class QueryResult:
 
 class QueryEngine:
     def __init__(self, catalog: Optional[Catalog] = None, use_jit: bool = True,
-                 cache_budget_bytes: int = 1 << 30):
+                 cache_budget_bytes: int = 1 << 30,
+                 chunk_budget_bytes: int = 2 << 30):
         from igloo_tpu.exec.cache import BatchCache
         self.catalog = catalog if catalog is not None else Catalog()
         self.udfs: dict[str, UdfDef] = {}
         self._jit_cache: dict = {}
         self._use_jit = use_jit
+        # source tables whose estimated size exceeds this execute partition-
+        # at-a-time (exec/chunked.py) instead of as one DeviceBatch
+        self.chunk_budget_bytes = chunk_budget_bytes
         # HBM batch cache: scan results stay device-resident across queries
         # (the real version of the reference's unenforced CacheConfig, gap G7)
         self.batch_cache = BatchCache(cache_budget_bytes)
@@ -144,10 +148,18 @@ class QueryEngine:
                         batch_cache=self.batch_cache)
 
     def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
+        from igloo_tpu.exec.chunked import LocalChunkExecutor, chunk_count
         with span("bind+optimize"):
             bound = Binder(self.catalog, udfs=self.udfs).bind(stmt)
             plan = optimize(bound)
-        ex = self._executor()
+        chunks = chunk_count(plan, self.chunk_budget_bytes)
+        if chunks:
+            ex = LocalChunkExecutor(self.catalog, self._jit_cache,
+                                    use_jit=self._use_jit,
+                                    batch_cache=self.batch_cache,
+                                    chunks=chunks)
+        else:
+            ex = self._executor()
         with span("execute"):
             table = ex.execute_to_arrow(plan)
         if want_plan:

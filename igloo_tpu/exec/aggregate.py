@@ -34,6 +34,21 @@ class AggSpec:
     arg: Optional[Compiled]       # None only for COUNT_STAR
     out_dtype: T.DataType
     out_dict: Optional[DictInfo]  # MIN/MAX over strings keep the arg dictionary
+    # MIN/MAX over an UNSORTED (high-cardinality) string dictionary: ids are
+    # not ranks, so comparisons run on this rank lane while values/output stay
+    # ids (executor wires expr_compile.rank_lane here)
+    order_arg: Optional[Compiled] = None
+
+
+def minmax_order_arg(func: AggFunc, arg: Optional[Compiled],
+                     comp) -> Optional[Compiled]:
+    """Rank lane for MIN/MAX over an unsorted high-cardinality string
+    dictionary (see AggSpec.order_arg); None when ids already order correctly."""
+    if func not in (AggFunc.MIN, AggFunc.MAX) or arg is None or \
+            arg.out_dict is None or arg.out_dict.is_sorted:
+        return None
+    from igloo_tpu.exec.expr_compile import rank_lane
+    return rank_lane(arg, comp)
 
 
 def seg_dims_for(groups: list[Compiled]) -> Optional[tuple[int, ...]]:
@@ -174,13 +189,17 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
     # exact gather of the original value at a winning position (so e.g. a NaN
     # winner comes back as NaN, not as its +inf ordering surrogate)
     pos = jnp.arange(cap, dtype=jnp.int32)
+    cmp_src = sv
+    if spec.order_arg is not None:
+        ov, _ = spec.order_arg.fn(env)
+        cmp_src = ov if perm is None else jnp.take(ov, perm)
     if spec.arg.dtype.is_float:
-        vnorm, nan = K.normalize_float(sv)
+        vnorm, nan = K.normalize_float(cmp_src)
         lane = jnp.where(nan, jnp.asarray(jnp.inf, vnorm.dtype), vnorm)
         lo = jnp.asarray(-jnp.inf, lane.dtype)
         hi = jnp.asarray(jnp.inf, lane.dtype)
     else:
-        lane = sv.astype(jnp.int64)
+        lane = cmp_src.astype(jnp.int64)
         lo = jnp.iinfo(jnp.int64).min
         hi = jnp.iinfo(jnp.int64).max
     if spec.func is AggFunc.MIN:
@@ -242,14 +261,17 @@ def _global_aggregate(env: Env, aggs: list[AggSpec], out_schema: T.Schema,
                                     all_null)
                 out_cols.append(DeviceColumn(spec.out_dtype, lane, nlo, None))
         else:  # MIN / MAX with exact winning-row gather (NaN stays NaN)
+            cmp_src = v
+            if spec.order_arg is not None:
+                cmp_src, _ = spec.order_arg.fn(env)
             if spec.arg.dtype.is_float:
-                vnorm, nan = K.normalize_float(v)
+                vnorm, nan = K.normalize_float(cmp_src)
                 lane_v = jnp.where(nan, jnp.asarray(jnp.inf, vnorm.dtype),
                                    vnorm)
                 lo = jnp.asarray(-jnp.inf, lane_v.dtype)
                 hi = jnp.asarray(jnp.inf, lane_v.dtype)
             else:
-                lane_v = v.astype(jnp.int64)
+                lane_v = cmp_src.astype(jnp.int64)
                 lo = jnp.iinfo(jnp.int64).min
                 hi = jnp.iinfo(jnp.int64).max
             keyed = jnp.where(valid, lane_v,

@@ -11,12 +11,14 @@ streams RecordBatch between operators). Design differences are deliberate TPU ch
   no dynamic shapes. Compaction happens only where required (joins, shuffles, output),
   via a stable sort on the mask — still static-shaped.
 
-- **Strings never touch HBM.** String columns are dictionary-encoded at scan time with
-  a per-table, lexicographically SORTED, unified dictionary; the device sees int32 ids.
-  Because the dictionary is sorted, ORDER BY / MIN / MAX / range predicates work
-  directly on ids; equality/LIKE/functions evaluate host-side over the (small)
-  dictionary and become id-lookups on device. Cross-table string comparisons (join
-  keys) go through per-entry 64-bit hashes (see `DictInfo.hashes`).
+- **Strings never touch HBM.** String columns are dictionary-encoded at scan time;
+  the device sees int32 ids. Small dictionaries (<= HIGH_CARD_THRESHOLD uniques) are
+  lexicographically sorted, so ORDER BY / MIN / MAX / range predicates work directly
+  on ids; high-cardinality dictionaries stay UNSORTED (`DictInfo.is_sorted=False` —
+  never compare such ids for order; order-sensitive operators must go through
+  `DictInfo.ranks()` via `expr_compile.rank_lane`). Equality/LIKE/functions evaluate
+  host-side over the dictionary and become id-lookups on device; cross-table string
+  comparisons (join keys) go through per-entry 64-bit hashes (see `DictInfo.hashes`).
 
 - **Nulls are a separate bool lane** (True = null), mirroring Arrow validity bitmaps
   but kept as full bool lanes for VPU-friendly masking.
@@ -55,15 +57,31 @@ _SM64_C2 = np.uint64(0x94D049BB133111EB)
 
 def hash64_bytes(values: Sequence[object], seed: int = 0) -> np.ndarray:
     """Host-side 64-bit FNV-1a + splitmix64-finalized hash of string values
-    (dictionary entries). Vectorized over entries: the python-level loop is over the
-    max string LENGTH, not over entries×bytes, so high-cardinality dictionaries
-    (e.g. TPC-H comment columns) hash at numpy speed. A C++ fast path may override
-    this via igloo_tpu.native (same algorithm, same results)."""
+    (dictionary entries). Prefers the native C path (igloo_tpu.native,
+    hash64.c — per-entry byte loop in C); falls back to a numpy
+    implementation vectorized over entries (the python-level loop is over the
+    max string LENGTH, not entries×bytes). Both produce identical results."""
     n = len(values)
     if n == 0:
         return np.empty(0, dtype=np.uint64)
     bufs = [(v.encode("utf-8") if isinstance(v, str) else bytes(v)) if v is not None else None
             for v in values]
+    from igloo_tpu import native
+    fast = native.hash64_batch(bufs, seed)
+    if fast is not None:
+        return fast
+    # numpy fallback: bound the (entries x max_len) working matrix — a
+    # 6M-entry comment column would otherwise materialize gigabytes at once.
+    # Chunk over the ALREADY-encoded bufs (not `values`) so nothing encodes twice.
+    _CHUNK = 1 << 18
+    if n > _CHUNK:
+        return np.concatenate([_hash64_np(bufs[i: i + _CHUNK], seed)
+                               for i in range(0, n, _CHUNK)])
+    return _hash64_np(bufs, seed)
+
+
+def _hash64_np(bufs: list, seed: int) -> np.ndarray:
+    n = len(bufs)
     lengths = np.asarray([len(b) if b is not None else 0 for b in bufs], dtype=np.int64)
     none_mask = np.asarray([b is None for b in bufs], dtype=bool)
     max_len = int(lengths.max()) if n else 0
@@ -95,18 +113,41 @@ def hash64_bytes(values: Sequence[object], seed: int = 0) -> np.ndarray:
 class DictInfo:
     """Host-side dictionary for a STRING column.
 
-    values:  np object array of python strings, lexicographically sorted.
+    values:  np object array of python strings. `is_sorted` marks the normal
+             (lexicographically sorted) encoding, where ids double as ranks and
+             order comparisons work directly on id lanes. High-cardinality
+             columns (> HIGH_CARD_THRESHOLD uniques, e.g. TPC-H comment
+             columns) skip the sort: ids are first-occurrence order
+             (is_sorted=False) — equality/grouping/joins/output still work on
+             ids, and order-sensitive operators gather through the lazily
+             computed `ranks()` LUT instead.
     hashes:  uint64[len] per-entry hash (seed 0)   — device-gatherable for join keys.
     hashes2: uint64[len] independent hash (seed 1) — collision guard (128-bit effective).
     """
     values: np.ndarray
     hashes: np.ndarray
     hashes2: np.ndarray
+    is_sorted: bool = True
 
     @staticmethod
     def from_values(values: Sequence[object]) -> "DictInfo":
         arr = np.asarray(list(values), dtype=object)
         return DictInfo(arr, hash64_bytes(arr, seed=0), hash64_bytes(arr, seed=1))
+
+    def ranks(self) -> np.ndarray:
+        """int32[len]: lexicographic rank per id. Identity for sorted
+        dictionaries; computed once (and cached) for unsorted ones — only
+        queries that actually ORDER/MIN/MAX/compare the column pay the sort."""
+        r = getattr(self, "_ranks", None)
+        if r is None:
+            if self.is_sorted:
+                r = np.arange(len(self.values), dtype=np.int32)
+            else:
+                order = np.argsort(self.values.astype(str), kind="stable")
+                r = np.empty(len(self.values), dtype=np.int32)
+                r[order] = np.arange(len(self.values), dtype=np.int32)
+            object.__setattr__(self, "_ranks", r)
+        return r
 
     def __len__(self) -> int:
         return len(self.values)
@@ -248,34 +289,70 @@ def dtype_to_arrow(d: DataType) -> pa.DataType:
     }[d.id]
 
 
+# above this many distinct values a column keeps its dictionary UNSORTED
+# (first-occurrence order from Arrow's C++ hash encoder): sorting millions of
+# near-unique strings host-side (e.g. TPC-H l_comment at SF1, ~6M uniques)
+# would dwarf query time, and only order-sensitive operators need ranks
+HIGH_CARD_THRESHOLD = 1 << 16
+
+
 def _encode_string_column(arr: pa.ChunkedArray, dict_info: Optional[DictInfo]):
-    """Dictionary-encode with a sorted dictionary. If `dict_info` is given, ids are
-    assigned against it (table-unified dictionary); values absent from it are an error
-    (scan builds the union up front)."""
+    """Dictionary-encode via Arrow's C++ hash encoder. Small dictionaries are
+    re-sorted so ids double as lexicographic ranks; high-cardinality ones stay
+    unsorted (DictInfo.is_sorted=False, see HIGH_CARD_THRESHOLD). If
+    `dict_info` is given, ids are assigned against it (table-unified
+    dictionary); values absent from it are an error (scan builds the union up
+    front)."""
     combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
-    if pa.types.is_dictionary(combined.type):
-        combined = combined.cast(pa.string()) if not pa.types.is_large_string(combined.type.value_type) else combined.cast(pa.large_string())
-    np_vals = combined.to_numpy(zero_copy_only=False)
-    null_mask = np.asarray([v is None for v in np_vals]) if combined.null_count else None
+    null_mask = None
+    if combined.null_count:
+        null_mask = np.asarray(combined.is_null())
+
     if dict_info is None:
-        uniq = sorted({v for v in np_vals if v is not None})
-        dict_info = DictInfo.from_values(uniq)
-    # searchsorted against the sorted dictionary gives ids == lexicographic ranks
+        if not pa.types.is_dictionary(combined.type):
+            combined = combined.dictionary_encode()
+        import pyarrow.compute as pc
+        indices = pc.fill_null(combined.indices, 0)
+        ids = np.asarray(indices).astype(np.int32)
+        dvals = combined.dictionary.to_numpy(zero_copy_only=False)
+        dvals = np.asarray(dvals, dtype=object)
+        if len(dvals) <= HIGH_CARD_THRESHOLD:
+            order = np.argsort(dvals.astype(str), kind="stable")
+            lut = np.empty(len(dvals), dtype=np.int32)
+            lut[order] = np.arange(len(dvals), dtype=np.int32)
+            if len(dvals):
+                ids = lut[ids]
+            dict_info = DictInfo.from_values(dvals[order])
+        else:
+            dict_info = DictInfo(dvals, hash64_bytes(dvals, seed=0),
+                                 hash64_bytes(dvals, seed=1), is_sorted=False)
+        return ids, null_mask, dict_info
+
+    # pre-unified dictionary: assign ids against it
+    if pa.types.is_dictionary(combined.type):
+        combined = combined.cast(pa.string()) \
+            if not pa.types.is_large_string(combined.type.value_type) \
+            else combined.cast(pa.large_string())
+    np_vals = combined.to_numpy(zero_copy_only=False)
     safe = np.asarray(["" if v is None else v for v in np_vals], dtype=object)
     if len(dict_info) == 0:
         if len(np_vals) and not all(v is None for v in np_vals):
             raise ValueError("string values present but unified dictionary is empty")
-        ids = np.zeros(len(np_vals), dtype=np.int32)
-    else:
+        return np.zeros(len(np_vals), dtype=np.int32), null_mask, dict_info
+    if dict_info.is_sorted:
         dstr = dict_info.values.astype(str)
         ids = np.searchsorted(dstr, safe.astype(str)).astype(np.int32)
         ids = np.clip(ids, 0, len(dict_info) - 1)
         ok = dstr[ids] == safe.astype(str)
-        if null_mask is not None:
-            ok = ok | null_mask
-        if not ok.all():
-            missing = sorted({str(v) for v, o in zip(safe, ok) if not o})[:5]
-            raise ValueError(f"string values not in unified dictionary: {missing}")
+    else:
+        index = {v: i for i, v in enumerate(dict_info.values.tolist())}
+        ids = np.asarray([index.get(v, 0) for v in safe], dtype=np.int32)
+        ok = np.asarray([v in index for v in safe], dtype=bool)
+    if null_mask is not None:
+        ok = ok | null_mask
+    if not ok.all():
+        missing = sorted({str(v) for v, o in zip(safe, ok) if not o})[:5]
+        raise ValueError(f"string values not in unified dictionary: {missing}")
     return ids, null_mask, dict_info
 
 

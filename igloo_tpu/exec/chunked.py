@@ -1,0 +1,110 @@
+"""Partition-at-a-time (chunked) execution for tables larger than one
+DeviceBatch budget.
+
+Reuses the cluster tier's fragmenting planner (cluster/fragment.py) with every
+fragment executed IN-PROCESS: scans stride the provider's partitions
+(parquet row groups, CSV files, MemTable splits), decomposable aggregates
+become per-chunk partial aggregates merged by a final fragment, and
+intermediate results live as host Arrow tables (partials are group-count
+sized, not input sized). The device never materializes more than one chunk of
+the base table at a time.
+
+Ceiling (documented per the build plan): only decomposable-aggregate-over-scan
+pipelines (Q1/Q6 shape) actually stream chunk-at-a-time — `chunk_count` routes
+ONLY those here. Plans whose over-budget scan feeds anything else (a bare
+sort/limit, a join side, a DISTINCT aggregate) would union all chunks back into
+one device batch, so they take the normal path unchanged; bounding join memory
+needs a partitioned (grace) hash join, which the sharded tier provides across
+chips but the single-device chunk path does not yet.
+
+Reference analog: the 1024-row streaming read batches of
+crates/engine/src/operators/parquet_scan.rs:54, which flow through operators
+one channel at a time but are never exploited for memory-bounded aggregation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+
+from igloo_tpu.plan import logical as L
+
+
+def estimated_bytes(provider) -> Optional[int]:
+    """Best-effort source size, host-side, without reading data."""
+    est = getattr(provider, "estimated_bytes", None)
+    if est is not None:
+        try:
+            return est()
+        except Exception:
+            return None
+    return None
+
+
+def chunk_count(plan: L.LogicalPlan, budget_bytes: int) -> int:
+    """How many chunks the largest over-budget scanned table needs (0 = no
+    chunking). Only scans that the fragment planner can actually stream —
+    i.e. feeding a DECOMPOSABLE aggregate through scan/filter/project nodes —
+    count: chunking anything else just unions the chunks back into one batch
+    and pays fragment overhead for no memory bound (see module docstring)."""
+    from igloo_tpu.cluster.fragment import _DECOMPOSABLE, _is_local
+    want = 0
+    for node in L.walk_plan(plan):
+        if not (isinstance(node, L.Aggregate) and _is_local(node.input) and
+                not any(a.distinct for a in node.aggs) and
+                all(a.func in _DECOMPOSABLE for a in node.aggs)):
+            continue
+        for sc in L.walk_plan(node.input):
+            if isinstance(sc, L.Scan) and sc.provider is not None and \
+                    sc.partition is None:
+                nbytes = estimated_bytes(sc.provider)
+                try:
+                    parts = sc.provider.num_partitions()
+                except Exception:
+                    parts = 1
+                if nbytes is not None and nbytes > budget_bytes and parts > 1:
+                    want = max(want,
+                               min(parts, -(-nbytes // budget_bytes), 64))
+    return want
+
+
+class LocalChunkExecutor:
+    """Executes a fragmented plan in-process, one fragment at a time."""
+
+    def __init__(self, catalog, jit_cache: Optional[dict] = None,
+                 use_jit: bool = True, batch_cache=None, chunks: int = 4):
+        self.catalog = catalog
+        self._jit_cache = jit_cache
+        self._use_jit = use_jit
+        self._batch_cache = batch_cache
+        self.chunks = max(2, chunks)
+
+    def execute_to_arrow(self, plan: L.LogicalPlan) -> pa.Table:
+        from igloo_tpu.catalog import MemTable
+        from igloo_tpu.cluster import serde
+        from igloo_tpu.cluster.fragment import FRAG_PREFIX, DistributedPlanner
+        from igloo_tpu.exec.executor import Executor
+
+        planner = DistributedPlanner(
+            [f"__chunk{i}" for i in range(self.chunks)])
+        frags = planner.plan(plan)
+
+        results: dict[str, pa.Table] = {}
+        base = self.catalog
+
+        class _Overlay:
+            def get(self, name: str):
+                key = name.lower()
+                if key.startswith(FRAG_PREFIX):
+                    return MemTable(results[key[len(FRAG_PREFIX):]])
+                return base.get(name)
+
+        overlay = _Overlay()
+        # fragments are appended children-first, so sequential order is
+        # dependency-safe; chunk results are host Arrow (partials are small)
+        for f in frags:
+            p = serde.plan_from_json(f.plan, overlay)
+            ex = Executor(self._jit_cache, use_jit=self._use_jit,
+                          batch_cache=self._batch_cache)
+            results[f.id] = ex.execute_to_arrow(p)
+        return results[frags[-1].id]

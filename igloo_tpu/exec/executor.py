@@ -28,7 +28,7 @@ from igloo_tpu import types as T
 from igloo_tpu.errors import ExecError, NotSupportedError, PlanError
 from igloo_tpu.exec import kernels as K
 from igloo_tpu.exec.aggregate import (
-    AggSpec, aggregate_batch, distinct_batch, seg_dims_for,
+    AggSpec, aggregate_batch, distinct_batch, minmax_order_arg, seg_dims_for,
 )
 from igloo_tpu.exec.batch import (
     DeviceBatch, DeviceColumn, DictInfo, from_arrow, round_capacity, to_arrow,
@@ -321,7 +321,8 @@ class Executor:
             else:
                 arg = None
             out_dict = arg.out_dict if (arg is not None and a.dtype.is_string) else None
-            specs.append(AggSpec(a.func, arg, a.dtype, out_dict))
+            specs.append(AggSpec(a.func, arg, a.dtype, out_dict,
+                                 order_arg=minmax_order_arg(a.func, arg, comp)))
         # direct-scatter eligibility is dictionary-CONTENT-dependent (sizes),
         # so it must join the cache key, not just shape signatures
         seg_dims = seg_dims_for(groups)
@@ -539,8 +540,11 @@ class Executor:
         return self._maybe_shrink(out)
 
     def _exec_sort(self, plan: L.Sort) -> DeviceBatch:
+        from igloo_tpu.exec.expr_compile import rank_lane
         batch = self._exec(plan.input)
         res, keys, comp = self._compile_exprs(plan.keys, batch)
+        # ORDER BY over unsorted (high-cardinality) dictionaries sorts ranks
+        keys = [rank_lane(k, comp) if k.dtype.is_string else k for k in keys]
         fp = ("sort", expr_fingerprint(res), tuple(plan.ascending),
               tuple(plan.nulls_first), batch_proto_key(batch),
               comp.pool.signature(), tuple(comp.marks))

@@ -157,6 +157,23 @@ def _unify_dicts(da: Optional[DictInfo], db: Optional[DictInfo]):
     return uinfo, lut_a, lut_b
 
 
+def rank_lane(c: Compiled, comp: "ExprCompiler") -> Compiled:
+    """Order-comparable lane for a string expression: the id lane itself when
+    the dictionary is sorted (ids are ranks), else a gather through the
+    lazily-computed rank LUT. Appends a mark — sortedness is dictionary
+    CONTENT, so it must influence the caller's compile-cache key."""
+    needs = c.out_dict is not None and not c.out_dict.is_sorted
+    comp.marks.append(("rank_lane", needs))
+    if not needs:
+        return c
+    ri = comp.pool.add(c.out_dict.ranks())
+
+    def fn(env):
+        v, nl = c.fn(env)
+        return _gather_const(v, env.consts[ri]), nl
+    return Compiled(fn, c.dtype, None)
+
+
 def _remap_ids(ids, lut: np.ndarray):
     if len(lut) == 0:
         return jnp.zeros_like(ids)
@@ -454,12 +471,16 @@ class ExprCompiler:
 
     def _compile_string_compare(self, op, lc: Compiled, rc: Compiled) -> Compiled:
         """Compare two string expressions. Same-dictionary columns compare by id
-        (dictionary is sorted => ids are lexicographic ranks); otherwise remap both
-        through the union dictionary host-side, then compare ids."""
+        (sorted dictionary => ids are lexicographic ranks; unsorted => order
+        comparisons go through the rank LUT); otherwise remap both through the
+        union dictionary host-side, then compare ids."""
         same = lc.out_dict is rc.out_dict and lc.out_dict is not None
         self.marks.append(("strcmp_same", same))
         if same:
             li = ri = None
+            if op not in (E.BinOp.EQ, E.BinOp.NEQ):
+                lc = rank_lane(lc, self)
+                rc = rank_lane(rc, self)
         else:
             _, lut_l, lut_r = _unify_dicts(lc.out_dict, rc.out_dict)
             li, ri = self.pool.add(lut_l), self.pool.add(lut_r)

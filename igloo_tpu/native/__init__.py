@@ -1,0 +1,92 @@
+"""Native (C) host-side fast paths.
+
+The reference's entire data path is native Rust; here the DEVICE path is
+XLA-compiled and the host-side loader hot spots are C, exposed through ctypes
+(pybind11 is not available in the target image). Currently: `hash64_batch`
+(hash64.c) — dictionary-entry hashing used by every string column load.
+
+The shared library is built on demand by scripts/build_native.sh (or lazily on
+first import when a C compiler is available); without it, callers fall back to
+the vectorized numpy implementation with identical results
+(exec/batch.hash64_bytes).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SO = os.path.join(_HERE, "_native.so")
+_SRC = os.path.join(_HERE, "hash64.c")
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    for cc in ("cc", "gcc", "g++", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                capture_output=True, timeout=120)
+            if r.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def _load():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SRC):
+        # installed without the C source: use an existing .so or fall back
+        if not os.path.exists(_SO):
+            return None
+    elif not os.path.exists(_SO) or \
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+        lib.hash64_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_void_p]
+        lib.hash64_batch.restype = None
+        _lib = lib
+    except OSError:
+        return None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def hash64_batch(bufs: list, seed: int) -> Optional[np.ndarray]:
+    """C fast path for exec/batch.hash64_bytes: `bufs` is a list of
+    bytes-or-None. Returns None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(bufs)
+    lengths = np.fromiter(
+        (len(b) if b is not None else -1 for b in bufs), dtype=np.int64,
+        count=n)
+    sizes = np.where(lengths > 0, lengths, 0)
+    starts = np.zeros(n, dtype=np.int64)
+    np.cumsum(sizes[:-1], out=starts[1:])
+    flat = b"".join(b for b in bufs if b)
+    buf = np.frombuffer(flat, dtype=np.uint8) if flat else \
+        np.zeros(1, dtype=np.uint8)
+    out = np.empty(n, dtype=np.uint64)
+    lib.hash64_batch(
+        buf.ctypes.data, starts.ctypes.data, lengths.ctypes.data,
+        ctypes.c_int64(n), ctypes.c_uint64(seed & 0xFFFFFFFFFFFFFFFF),
+        out.ctypes.data)
+    return out

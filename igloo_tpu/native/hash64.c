@@ -1,0 +1,45 @@
+/* Host-side dictionary hashing: FNV-1a over utf-8 bytes, splitmix64 finalize.
+ *
+ * Same algorithm and results as the numpy fallback in exec/batch.py
+ * (hash64_bytes); this is the native data-loader hot path — dictionary
+ * encoding of high-cardinality string columns hashes millions of entries per
+ * table load, and the per-entry byte loop belongs in C, not in a numpy
+ * broadcast over an (entries x max_len) matrix.
+ *
+ * Role parity: the reference keeps its whole data path native (Rust); here
+ * the device path is XLA and the host-side loader hot spots are C (built by
+ * scripts/build_native.sh into _native.so, loaded via ctypes —
+ * igloo_tpu/native/__init__.py).
+ *
+ * Layout: items are concatenated in `buf`; item i spans
+ * buf[starts[i] .. starts[i]+lengths[i]).  lengths[i] < 0 marks a NULL entry
+ * (hash = seed ^ GOLDEN, matching the fallback).
+ */
+#include <stdint.h>
+
+#define GOLDEN 0x9E3779B97F4A7C15ULL
+#define FNV_PRIME 0x100000001B3ULL
+#define SM64_C1 0xBF58476D1CE4E5B9ULL
+#define SM64_C2 0x94D049BB133111EBULL
+
+void hash64_batch(const uint8_t *buf, const int64_t *starts,
+                  const int64_t *lengths, int64_t n, uint64_t seed,
+                  uint64_t *out) {
+    for (int64_t i = 0; i < n; i++) {
+        if (lengths[i] < 0) { /* NULL entry */
+            out[i] = seed ^ GOLDEN;
+            continue;
+        }
+        const uint8_t *p = buf + starts[i];
+        const uint8_t *end = p + lengths[i];
+        uint64_t h = seed + GOLDEN;
+        for (; p < end; p++) {
+            h = (h ^ (uint64_t)*p) * FNV_PRIME;
+        }
+        /* splitmix64 finalize */
+        h ^= h >> 30; h *= SM64_C1;
+        h ^= h >> 27; h *= SM64_C2;
+        h ^= h >> 31;
+        out[i] = h;
+    }
+}

@@ -220,6 +220,12 @@ class ShardedExecutor(Executor):
             elif a.func in _ASSOCIATIVE:
                 out_dict = arg.out_dict if (arg is not None and
                                             a.dtype.is_string) else None
+                if out_dict is not None and not out_dict.is_sorted:
+                    # MIN/MAX over an unsorted high-cardinality dictionary:
+                    # the final mesh stage runs without const args, so the
+                    # rank-lane plumbing can't reach it — gather instead
+                    return super()._aggregate(self._gathered(batch),
+                                              group_exprs, aggs, out_schema)
                 partial_specs.append(AggSpec(a.func, arg, a.dtype, out_dict))
                 partial_fields.append(T.Field(f"a{pi}", a.dtype, True))
                 final_plan.append(("assoc", pi, a))

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Full validation pipeline — mirror of the reference's scripts/validate.sh
+# (fmt + clippy -D warnings + check + build + test): lint strict, then the
+# whole suite on the virtual 8-device CPU mesh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ruff (lint) =="
+if python -c "import ruff" 2>/dev/null || command -v ruff >/dev/null; then
+  python -m ruff check igloo_tpu tests bench.py __graft_entry__.py
+else
+  echo "ruff not installed here; skipping lint (CI runs it)"
+fi
+
+echo "== pytest (full suite, virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== graft entry (single-chip jit + 8-device dryrun) =="
+python __graft_entry__.py
+
+echo "validate: OK"
