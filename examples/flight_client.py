@@ -1,0 +1,23 @@
+"""The coordinator speaks plain Arrow Flight: any stock client works — no
+igloo_tpu import needed on the client side.
+
+    python examples/flight_client.py grpc+tcp://127.0.0.1:50051 "SELECT 1 AS x"
+"""
+import sys
+
+import pyarrow.flight as flight
+
+
+def main(addr: str, sql: str):
+    client = flight.connect(addr)
+    # schema without executing
+    info = client.get_flight_info(flight.FlightDescriptor.for_command(sql.encode()))
+    print("schema:", info.schema)
+    # execute: the ticket IS the SQL
+    table = client.do_get(flight.Ticket(sql.encode())).read_all()
+    print(table.to_pandas().to_string(index=False))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "grpc+tcp://127.0.0.1:50051",
+         sys.argv[2] if len(sys.argv) > 2 else "SELECT 1 AS x")

@@ -1,0 +1,32 @@
+"""Minimal local session: register a Parquet file, run SQL on the default
+device (TPU when visible, else CPU).
+
+    python examples/local_query.py
+"""
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import igloo_tpu
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 100_000
+    pq.write_table(pa.table({
+        "region": pa.array([f"r{i % 5}" for i in range(n)]),
+        "amount": np.round(rng.random(n) * 100, 2),
+        "qty": rng.integers(1, 20, n),
+    }), "/tmp/sales.parquet")
+
+    sess = igloo_tpu.connect()
+    sess.register_parquet("sales", "/tmp/sales.parquet")
+    out = sess.sql("""
+        SELECT region, count(*) AS orders, sum(amount * qty) AS revenue
+        FROM sales GROUP BY region ORDER BY revenue DESC
+    """)
+    print(out.to_pandas().to_string(index=False))
+
+
+if __name__ == "__main__":
+    main()

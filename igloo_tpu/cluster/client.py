@@ -32,6 +32,11 @@ class DistributedClient:
     def cluster_status(self) -> dict:
         return self._action("cluster_status")
 
+    def last_metrics(self) -> dict:
+        """Per-fragment metrics of the last distributed query (worker, rows,
+        elapsed_s per fragment + totals)."""
+        return self._action("last_metrics")
+
     def tables(self) -> list[str]:
         return self.cluster_status()["tables"]
 

@@ -113,6 +113,10 @@ class DistributedExecutor:
         self.membership = membership
         self.max_parallel = max_parallel
         self.max_recoveries = max_recoveries
+        # per-fragment metrics of the most recent query: the working version
+        # of the reference's never-populated QueryComplete{total_rows,
+        # execution_time_ms} (distributed.proto:66-69, SURVEY §5.5)
+        self.last_metrics: dict = {}
 
     def execute(self, fragments: list[QueryFragment]) -> pa.Table:
         frags = {f.id: f for f in fragments}
@@ -120,6 +124,8 @@ class DistributedExecutor:
         completed: dict[str, str] = {}  # frag id -> worker addr holding result
         pending = set(frags)
         recoveries = 0
+        t_start = time.time()
+        self.last_metrics = {"fragments": [], "recoveries": 0}
         try:
             with cf.ThreadPoolExecutor(self.max_parallel) as pool:
                 while pending:
@@ -154,7 +160,11 @@ class DistributedExecutor:
                             raise IglooError(
                                 "giving up after repeated worker failures")
                         self._recover(dead, frags, completed, pending)
-                return self._fetch(completed[root_id], root_id)
+                table = self._fetch(completed[root_id], root_id)
+                self.last_metrics.update(
+                    total_rows=table.num_rows, recoveries=recoveries,
+                    execution_time_s=round(time.time() - t_start, 6))
+                return table
         finally:
             self._release(frags, completed, list(frags))
 
@@ -167,7 +177,8 @@ class DistributedExecutor:
         req = {"id": f.id, "plan": f.plan,
                "deps": [{"id": d, "addr": completed[d]} for d in f.deps]}
         try:
-            flight_action(f.worker, "execute_fragment", req)
+            info = flight_action(f.worker, "execute_fragment", req)
+            self.last_metrics["fragments"].append(info)
         except flight.FlightServerError as ex:
             marker = "DEP_UNAVAILABLE:"
             msg = str(ex)
@@ -360,6 +371,8 @@ class CoordinatorServer(flight.FlightServerBase):
                             for w in self.membership.live()],
                 "tables": sorted(self.engine.catalog.names()),
             }).encode()]
+        if action.type == "last_metrics":
+            return [json.dumps(self.executor.last_metrics).encode()]
         if action.type == "ping":
             return [json.dumps({"workers": len(self.membership.live())}).encode()]
         raise flight.FlightServerError(f"unknown action {action.type}")
@@ -369,6 +382,7 @@ class CoordinatorServer(flight.FlightServerBase):
                 ("heartbeat", "worker liveness heartbeat"),
                 ("register_table", "register a table from a provider spec"),
                 ("cluster_status", "membership + catalog snapshot"),
+                ("last_metrics", "per-fragment metrics of the last query"),
                 ("ping", "liveness")]
 
     def get_flight_info(self, context, descriptor):

@@ -157,8 +157,7 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
     `nseg` (= cap on the sort path, the padded segment count on the direct
     path)."""
     if spec.func is AggFunc.COUNT_STAR:
-        cnt = jax.ops.segment_sum(s_live.astype(jnp.int64), seg,
-                                  num_segments=nseg)
+        cnt = K.seg_sum(s_live.astype(jnp.int64), seg, nseg)
         return DeviceColumn(T.INT64, cnt, None, None)
 
     v, nl = spec.arg.fn(env)
@@ -166,8 +165,7 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
     snl = nl if perm is None else (jnp.take(nl, perm)
                                    if nl is not None else None)
     valid = s_live if snl is None else (s_live & ~snl)
-    n_valid = jax.ops.segment_sum(valid.astype(jnp.int64), seg,
-                                  num_segments=nseg)
+    n_valid = K.seg_sum(valid.astype(jnp.int64), seg, nseg)
     all_null = n_valid == 0
 
     if spec.func is AggFunc.COUNT:
@@ -177,7 +175,7 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
         acc_dtype = jnp.float64 if (spec.out_dtype.is_float or
                                     spec.func is AggFunc.AVG) else jnp.int64
         sval = jnp.where(valid, sv.astype(acc_dtype), jnp.zeros((), acc_dtype))
-        total = jax.ops.segment_sum(sval, seg, num_segments=nseg)
+        total = K.seg_sum(sval, seg, nseg)
         if spec.func is AggFunc.AVG:
             denom = jnp.where(all_null, 1, n_valid).astype(jnp.float64)
             return DeviceColumn(T.FLOAT64, total / denom, all_null, None)
@@ -204,14 +202,13 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
         hi = jnp.iinfo(jnp.int64).max
     if spec.func is AggFunc.MIN:
         keyed = jnp.where(valid, lane, hi)
-        best_lane = jax.ops.segment_min(keyed, seg, num_segments=nseg)
+        best_lane = K.seg_min(keyed, seg, nseg)
     else:
         keyed = jnp.where(valid, lane, lo)
-        best_lane = jax.ops.segment_max(keyed, seg, num_segments=nseg)
+        best_lane = K.seg_max(keyed, seg, nseg)
     # recover a row index holding the winning lane value for exact value gather
     is_best = valid & (keyed == jnp.take(best_lane, seg))
-    best_pos = jax.ops.segment_min(jnp.where(is_best, pos, jnp.int32(cap)), seg,
-                                   num_segments=nseg)
+    best_pos = K.seg_min(jnp.where(is_best, pos, jnp.int32(cap)), seg, nseg)
     best_pos = jnp.clip(best_pos, 0, cap - 1)
     out_val = jnp.take(sv, best_pos)
     return DeviceColumn(spec.out_dtype, out_val, all_null, spec.out_dict)
@@ -309,11 +306,9 @@ def _direct_aggregate(env: Env, groups: list[Compiled], gvals, gnulls,
     seg = jnp.where(live, seg, jnp.int32(dead))
 
     pos = jnp.arange(cap, dtype=jnp.int32)
-    counts = jax.ops.segment_sum(live.astype(jnp.int32), seg,
-                                 num_segments=nseg)
+    counts = K.seg_sum(live.astype(jnp.int32), seg, nseg)
     group_mask = (counts > 0) & (jnp.arange(nseg) < prod)
-    first_pos = jax.ops.segment_min(jnp.where(live, pos, jnp.int32(cap)), seg,
-                                    num_segments=nseg)
+    first_pos = K.seg_min(jnp.where(live, pos, jnp.int32(cap)), seg, nseg)
     first_pos = jnp.clip(first_pos, 0, cap - 1)
 
     out_cols: list[DeviceColumn] = []

@@ -21,3 +21,11 @@ jax.config.update("jax_enable_x64", True)
 assert jax.default_backend() == "cpu", (
     "test suite must run on the virtual CPU mesh, got "
     f"{jax.default_backend()}")
+
+# single-device execution by default: the 8 virtual devices exist for the
+# sharding tests (test_parallel.py, test_engine_mesh.py), which opt in with an
+# explicit mesh — without this pin, QueryEngine's "auto" mesh would flip the
+# whole suite to sharded execution and single-device paths would lose coverage
+import igloo_tpu.engine  # noqa: E402
+
+igloo_tpu.engine.DEFAULT_MESH = None

@@ -151,6 +151,21 @@ def test_distributed_uses_fragments(cluster):
     assert frags[-1].deps
 
 
+def test_query_metrics_surface(cluster):
+    """The reference defines QueryComplete{total_rows, execution_time_ms} and
+    never populates it (distributed.proto:66-69); ours is real."""
+    client = DistributedClient(cluster["addr"])
+    t = client.execute("SELECT o_status, COUNT(*) AS c FROM orders "
+                       "GROUP BY o_status ORDER BY o_status")
+    m = client.last_metrics()
+    assert m["total_rows"] == t.num_rows
+    assert m["execution_time_s"] > 0
+    assert len(m["fragments"]) >= 2  # partials + merge
+    for f in m["fragments"]:
+        assert f["rows"] >= 0 and f["elapsed_s"] >= 0 and f["worker"]
+    client.close()
+
+
 def test_client_schema_without_execution(cluster):
     client = DistributedClient(cluster["addr"])
     schema = client.schema("SELECT o_id, o_total FROM orders")
