@@ -20,6 +20,23 @@ import jax
 # TPU target supports both (f64 via correct emulation — verified by probe).
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: join-heavy TPC-H stages cost minutes of
+# cold compile on TPU; caching them on disk makes every process after the
+# first start warm. Opt out with IGLOO_TPU_COMPILE_CACHE=0 (or point it at a
+# different directory).
+import os as _os  # noqa: E402
+
+_cache_dir = _os.environ.get(
+    "IGLOO_TPU_COMPILE_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "igloo_tpu_xla"))
+if _cache_dir and _cache_dir != "0":
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # older jax without the knobs: cold compiles only
+        pass
+
 from igloo_tpu import types  # noqa: E402,F401
 from igloo_tpu.version import __version__  # noqa: E402,F401
 

@@ -28,14 +28,16 @@ def sample_users_table() -> pa.Table:
 def build_engine(cfg, use_jit: bool = True):
     from igloo_tpu.config import make_provider
     from igloo_tpu.engine import QueryEngine
-    mesh = "auto"
-    if cfg is not None and cfg.mesh_shape:
-        import math
-        from igloo_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh(math.prod(cfg.mesh_shape))
-    engine = QueryEngine(use_jit=use_jit, mesh=mesh,
-                         cache_budget_bytes=cfg.cache_budget_bytes
-                         if cfg is not None else 1 << 30)
+    kw = {}
+    if cfg is not None:
+        kw["cache_budget_bytes"] = cfg.cache_budget_bytes
+        if cfg.mesh_shape:
+            import math
+            from igloo_tpu.parallel.mesh import make_mesh
+            kw["mesh"] = make_mesh(math.prod(cfg.mesh_shape))
+    # no explicit mesh config -> engine "default" sentinel (DEFAULT_MESH),
+    # keeping the process-level knob authoritative
+    engine = QueryEngine(use_jit=use_jit, **kw)
     registered = False
     if cfg is not None:
         for t in cfg.tables:

@@ -125,7 +125,9 @@ class DistributedExecutor:
         pending = set(frags)
         recoveries = 0
         t_start = time.time()
-        self.last_metrics = {"fragments": [], "recoveries": 0}
+        # per-QUERY metrics dict: concurrent queries each build their own and
+        # publish atomically at the end (last_metrics = last completed query)
+        metrics: dict = {"fragments": [], "recoveries": 0}
         try:
             with cf.ThreadPoolExecutor(self.max_parallel) as pool:
                 while pending:
@@ -134,7 +136,8 @@ class DistributedExecutor:
                     if not ready:
                         raise IglooError(
                             "circular dependency in fragment graph")
-                    futs = {pool.submit(self._dispatch, f, dict(completed)): f
+                    futs = {pool.submit(self._dispatch, f, dict(completed),
+                                        metrics): f
                             for f in ready}
                     dead: set[str] = set()
                     lost_deps: set[str] = set()
@@ -161,9 +164,16 @@ class DistributedExecutor:
                                 "giving up after repeated worker failures")
                         self._recover(dead, frags, completed, pending)
                 table = self._fetch(completed[root_id], root_id)
-                self.last_metrics.update(
+                # dedupe by fragment id (a fragment re-run after a worker
+                # death appends twice; last execution wins)
+                by_id: dict = {}
+                for info in metrics["fragments"]:
+                    by_id[info.get("id", len(by_id))] = info
+                metrics["fragments"] = list(by_id.values())
+                metrics.update(
                     total_rows=table.num_rows, recoveries=recoveries,
                     execution_time_s=round(time.time() - t_start, 6))
+                self.last_metrics = metrics  # atomic publish
                 return table
         finally:
             self._release(frags, completed, list(frags))
@@ -173,12 +183,13 @@ class DistributedExecutor:
     def _live_addrs(self) -> list[str]:
         return [w.addr for w in self.membership.live()]
 
-    def _dispatch(self, f: QueryFragment, completed: dict[str, str]) -> None:
+    def _dispatch(self, f: QueryFragment, completed: dict[str, str],
+                  metrics: dict) -> None:
         req = {"id": f.id, "plan": f.plan,
                "deps": [{"id": d, "addr": completed[d]} for d in f.deps]}
         try:
             info = flight_action(f.worker, "execute_fragment", req)
-            self.last_metrics["fragments"].append(info)
+            metrics["fragments"].append(info)
         except flight.FlightServerError as ex:
             marker = "DEP_UNAVAILABLE:"
             msg = str(ex)

@@ -123,12 +123,17 @@ def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
     seg, start = K.group_segments(s_lanes, s_nulls, s_live)
     num_groups = jnp.sum(start.astype(jnp.int32))
 
-    # first sorted row of each segment (for group representative values)
-    pos = jnp.arange(cap, dtype=jnp.int32)
-    big = jnp.int32(cap)
-    first_pos = jax.ops.segment_min(jnp.where(s_live, pos, big), seg,
-                                    num_segments=cap)
-    first_pos = jnp.clip(first_pos, 0, cap - 1)
+    # sorted segments are CONTIGUOUS runs, so segment boundaries come from the
+    # start flags (no scatter): row k of the output is segment k, whose first
+    # sorted position is the k-th True in `start` — compact_perm lists those
+    # positions ascending. bounds = (start_idx, end_idx) per output row.
+    start_idx = K.compact_perm(start)  # [cap] int32; rows >= num_groups garbage
+    nxt = jnp.concatenate([start_idx[1:], jnp.full((1,), cap, jnp.int32)])
+    k_idx = jnp.arange(cap, dtype=jnp.int32)
+    end_idx = jnp.where(k_idx + 1 < num_groups, nxt, jnp.int32(cap)) - 1
+    end_idx = jnp.clip(end_idx, 0, cap - 1)
+    bounds = (start_idx, end_idx)
+    first_pos = start_idx
 
     out_cols: list[DeviceColumn] = []
     # group key output columns
@@ -144,20 +149,46 @@ def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
 
     # aggregates via segment reductions over sorted order
     for spec in aggs:
-        out_cols.append(_reduce_one(spec, env, perm, seg, s_live, cap, cap))
+        out_cols.append(_reduce_one(spec, env, perm, seg, s_live, cap, cap,
+                                    bounds=bounds))
 
     out_live = jnp.arange(cap, dtype=jnp.int32) < num_groups
     return DeviceBatch(out_schema, out_cols, out_live)
 
 
+def _run_sum(vals: jax.Array, bounds) -> jax.Array:
+    """Per-segment sum over CONTIGUOUS (sorted) segments as cumsum boundary
+    differences — gathers only, no scatter (a TPU scatter over a full lane
+    costs ~300ms; this is one bandwidth-bound pass + two gathers).
+
+    INTEGER lanes only: int cumsum differences are exact (wraparound cancels),
+    while a float cumsum would (a) let one group's inf/NaN poison every LATER
+    group (inf - inf = NaN at the boundary difference) and (b) round each
+    group at the magnitude of the global running sum instead of its own.
+    Float sums keep the isolated segment reduction."""
+    start_idx, end_idx = bounds
+    cs = jnp.cumsum(vals)
+    before = jnp.where(start_idx > 0,
+                       jnp.take(cs, jnp.clip(start_idx - 1, 0, None)),
+                       jnp.zeros((), cs.dtype))
+    return jnp.take(cs, end_idx) - before
+
+
 def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
-                nseg) -> DeviceColumn:
+                nseg, bounds=None) -> DeviceColumn:
     """Segment reduction for one aggregate. `perm` sorts rows into segment
     order (None = rows already aligned with `seg`); output arrays have length
     `nseg` (= cap on the sort path, the padded segment count on the direct
-    path)."""
+    path). `bounds` = per-output-row (start, end) sorted positions when
+    segments are contiguous: INTEGER sums (counts, int SUM) then run
+    scatter-free via cumsum differences (see _run_sum for why floats don't)."""
+    def ssum(vals):
+        if bounds is not None and jnp.issubdtype(vals.dtype, jnp.integer):
+            return _run_sum(vals, bounds)
+        return K.seg_sum(vals, seg, nseg)
+
     if spec.func is AggFunc.COUNT_STAR:
-        cnt = K.seg_sum(s_live.astype(jnp.int64), seg, nseg)
+        cnt = ssum(s_live.astype(jnp.int64))
         return DeviceColumn(T.INT64, cnt, None, None)
 
     v, nl = spec.arg.fn(env)
@@ -165,7 +196,7 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
     snl = nl if perm is None else (jnp.take(nl, perm)
                                    if nl is not None else None)
     valid = s_live if snl is None else (s_live & ~snl)
-    n_valid = K.seg_sum(valid.astype(jnp.int64), seg, nseg)
+    n_valid = ssum(valid.astype(jnp.int64))
     all_null = n_valid == 0
 
     if spec.func is AggFunc.COUNT:
@@ -175,7 +206,7 @@ def _reduce_one(spec: AggSpec, env: Env, perm, seg, s_live, cap,
         acc_dtype = jnp.float64 if (spec.out_dtype.is_float or
                                     spec.func is AggFunc.AVG) else jnp.int64
         sval = jnp.where(valid, sv.astype(acc_dtype), jnp.zeros((), acc_dtype))
-        total = K.seg_sum(sval, seg, nseg)
+        total = ssum(sval)
         if spec.func is AggFunc.AVG:
             denom = jnp.where(all_null, 1, n_valid).astype(jnp.float64)
             return DeviceColumn(T.FLOAT64, total / denom, all_null, None)

@@ -202,11 +202,31 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         rv, rn = residual.fn(env)
         ok = ok & rv & (~rn if rn is not None else True)
 
-    # --- matched flags on both sides (int32 scatter-max: bool scatter support
-    # varies across backends) ---
-    ok32 = ok.astype(jnp.int32)
-    l_matched = jnp.zeros((cap_l,), dtype=jnp.int32).at[probe_idx].max(ok32) > 0
-    r_matched = jnp.zeros((right.capacity,), dtype=jnp.int32).at[r_idx].max(ok32) > 0
+    # --- matched flags, computed only for the join types that read them (a
+    # TPU scatter over a full lane costs ~300ms; INNER needs neither flag) ---
+    l_matched = r_matched = None
+    if join_type in (JoinType.LEFT, JoinType.FULL, JoinType.SEMI,
+                     JoinType.ANTI):
+        # probe_idx is NONDECREASING (slots for one probe row are contiguous
+        # by construction), so "row i has a verified match" is a cumsum range
+        # query — gathers only, no scatter:
+        #   matched[i] = cumsum(ok)[prefix[i] + counts[i] - 1] - cumsum(ok)[prefix[i] - 1] > 0
+        c = jnp.cumsum(ok.astype(jnp.int64))
+        hi = p.prefix + p.counts.astype(jnp.int64)  # exclusive end slot
+        hi_idx = jnp.clip(hi - 1, 0, match_cap - 1).astype(jnp.int32)
+        lo = p.prefix
+        c_before = jnp.where(lo > 0,
+                             jnp.take(c, jnp.clip(lo - 1, 0,
+                                                  match_cap - 1).astype(jnp.int32)),
+                             jnp.int64(0))
+        in_cap = hi <= match_cap  # overflowed rows handled by the re-run
+        l_matched = in_cap & (p.counts > 0) & \
+            ((jnp.take(c, hi_idx) - c_before) > 0)
+    if join_type in (JoinType.RIGHT, JoinType.FULL):
+        # build side order is arbitrary -> keep the scatter (rare join types)
+        ok32 = ok.astype(jnp.int32)
+        r_matched = jnp.zeros((right.capacity,), dtype=jnp.int32) \
+            .at[r_idx].max(ok32, mode="drop") > 0
 
     if join_type is JoinType.SEMI:
         return DeviceBatch(out_schema, left.columns, left.live & l_matched)
@@ -258,12 +278,17 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         proto = parts_cols[0][ci]
         out_cols.append(DeviceColumn(proto.dtype, vals, nulls, proto.dictionary))
     out_live = jnp.concatenate(parts_live)
-    # compact the whole output so live rows are contiguous
-    perm = K.compact_perm(out_live)
-    out_cols = [DeviceColumn(c.dtype, jnp.take(c.values, perm),
-                             jnp.take(c.nulls, perm) if c.nulls is not None else None,
-                             c.dictionary) for c in out_cols]
-    return DeviceBatch(out_schema, out_cols, jnp.take(out_live, perm))
+    if len(parts_live) > 1:
+        # outer joins: interleave the unmatched parts into contiguous rows;
+        # inner joins skip this — their single part is already compacted, and
+        # the full-width argsort here costs a ~2M-lane sort per join
+        perm = K.compact_perm(out_live)
+        out_cols = [DeviceColumn(c.dtype, jnp.take(c.values, perm),
+                                 jnp.take(c.nulls, perm)
+                                 if c.nulls is not None else None,
+                                 c.dictionary) for c in out_cols]
+        out_live = jnp.take(out_live, perm)
+    return DeviceBatch(out_schema, out_cols, out_live)
 
 
 def _null_cols(batch: DeviceBatch, cap: int) -> list[DeviceColumn]:

@@ -78,9 +78,14 @@ def roots() -> list:
     return _tls.roots
 
 
-def reset() -> None:
+def reset(counters_too: bool = True) -> None:
+    """Clear the thread-local span trace AND (by default) the process-wide
+    counters. Counters used to survive reset(), which made per-query counter
+    deltas read as cumulative totals — an hour of phantom cache-bug hunting."""
     _tls.stack = []
     _tls.roots = []
+    if counters_too:
+        reset_counters()
 
 
 @contextlib.contextmanager

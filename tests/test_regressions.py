@@ -171,3 +171,20 @@ def test_exists_with_non_equi_correlated_predicate():
         "SELECT DISTINCT o FROM li a WHERE NOT EXISTS (SELECT 1 FROM li b "
         "WHERE b.o = a.o AND b.s <> a.s) ORDER BY o")
     assert got2.column("o").to_pylist() == [2, 3]
+
+
+def test_sort_path_aggregate_inf_isolated():
+    # review finding: the cumsum-difference segment sum let one group's
+    # inf/NaN poison every later group; float sums must stay isolated
+    t = pa.table({
+        # non-dictionary int64 keys force the sort aggregation path
+        "k": pa.array([1000001, 1000001, 2000002, 2000002, 3000003],
+                      type=pa.int64()),
+        "x": [float("inf"), 1.0, 2.0, 3.0, 4.0],
+    })
+    eng2 = QueryEngine()
+    eng2.register_table("inf_t", t)
+    got = eng2.execute("SELECT k, SUM(x) AS s, COUNT(*) AS c FROM inf_t "
+                       "GROUP BY k ORDER BY k")
+    assert got.column("s").to_pylist() == [float("inf"), 5.0, 4.0]
+    assert got.column("c").to_pylist() == [2, 2, 1]
