@@ -22,14 +22,21 @@ jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: join-heavy TPC-H stages cost minutes of
 # cold compile on TPU; caching them on disk makes every process after the
-# first start warm. Opt out with IGLOO_TPU_COMPILE_CACHE=0 (or point it at a
-# different directory).
+# first start warm. IGLOO_TPU_COMPILE_CACHE: 0/false/off disables,
+# 1/true/on (or unset) uses the default directory, anything else is the
+# directory to use.
 import os as _os  # noqa: E402
 
-_cache_dir = _os.environ.get(
-    "IGLOO_TPU_COMPILE_CACHE",
-    _os.path.join(_os.path.expanduser("~"), ".cache", "igloo_tpu_xla"))
-if _cache_dir and _cache_dir != "0":
+_cache_raw = _os.environ.get("IGLOO_TPU_COMPILE_CACHE", "1")
+_cache_flag = _cache_raw.strip().lower()
+if _cache_flag in ("0", "false", "off", "no", ""):
+    _cache_dir = None
+elif _cache_flag in ("1", "true", "on", "yes"):
+    _cache_dir = _os.path.join(_os.path.expanduser("~"), ".cache",
+                               "igloo_tpu_xla")
+else:
+    _cache_dir = _cache_raw
+if _cache_dir:
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -78,10 +78,14 @@ def roots() -> list:
     return _tls.roots
 
 
-def reset(counters_too: bool = True) -> None:
-    """Clear the thread-local span trace AND (by default) the process-wide
-    counters. Counters used to survive reset(), which made per-query counter
-    deltas read as cumulative totals — an hour of phantom cache-bug hunting."""
+def reset(counters_too: bool = False) -> None:
+    """Clear the thread-local span trace. Counters are PROCESS-WIDE and
+    CUMULATIVE and are NOT cleared by default — per-query deltas must be
+    snapshot-diffed (c0 = counters(); ...; diff against c0), or pass
+    counters_too=True in single-threaded tooling that owns the whole process
+    (clearing them from one thread would corrupt other in-flight queries'
+    metrics). Misreading cumulative counters as per-query deltas once cost an
+    hour of phantom cache-bug hunting; hence this warning."""
     _tls.stack = []
     _tls.roots = []
     if counters_too:

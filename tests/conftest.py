@@ -12,11 +12,16 @@ import os
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# keep igloo_tpu's import-time cache config off too (see update below)
+os.environ["IGLOO_TPU_COMPILE_CACHE"] = "0"
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# no persistent compile cache for the CPU suite: reloading CPU AOT entries
+# across host-feature detection contexts risks SIGILL (cache is for TPU)
+jax.config.update("jax_compilation_cache_dir", None)
 
 assert jax.default_backend() == "cpu", (
     "test suite must run on the virtual CPU mesh, got "
