@@ -53,7 +53,7 @@ class WorkerServer(flight.FlightServerBase):
     on its own thread; the fragment store and engine state are lock-guarded."""
 
     def __init__(self, location: str, worker_id: Optional[str] = None,
-                 use_jit: bool = True, **kw):
+                 use_jit: bool = True, mesh: object = "default", **kw):
         super().__init__(location, **kw)
         self.worker_id = worker_id or uuid.uuid4().hex[:12]
         self.advertise: str = location
@@ -62,12 +62,27 @@ class WorkerServer(flight.FlightServerBase):
         self._lock = threading.Lock()
         self._use_jit = use_jit
         self._jit_cache: dict = {}
+        self._mesh_setting = mesh  # same rule as QueryEngine (resolve_mesh)
+        self._mesh = None
         from igloo_tpu.exec.cache import BatchCache
         self._batch_cache = BatchCache(1 << 30)
 
     # --- execution ---
 
     def _executor(self):
+        # multi-chip worker hosts row-shard fragments across their local
+        # devices; same mesh-resolution rule as QueryEngine (so tests pin
+        # DEFAULT_MESH and production configures via the constructor)
+        if self._mesh is None and self._mesh_setting is not None:
+            from igloo_tpu.parallel.mesh import resolve_mesh
+            self._mesh = resolve_mesh(self._mesh_setting)
+            if self._mesh is None:
+                self._mesh_setting = None
+        if self._mesh is not None:
+            from igloo_tpu.parallel.executor import ShardedExecutor
+            return ShardedExecutor(self._jit_cache, use_jit=self._use_jit,
+                                   batch_cache=self._batch_cache,
+                                   mesh=self._mesh)
         from igloo_tpu.exec.executor import Executor
         return Executor(self._jit_cache, use_jit=self._use_jit,
                         batch_cache=self._batch_cache)

@@ -161,15 +161,10 @@ class QueryEngine:
     def _resolve_mesh(self):
         """The execution mesh, resolved once: None for single-device."""
         if self._mesh is None and self._mesh_setting is not None:
-            if self._mesh_setting == "auto":
-                import jax
-                if len(jax.devices()) > 1:
-                    from igloo_tpu.parallel.mesh import make_mesh
-                    self._mesh = make_mesh()
-                else:
-                    self._mesh_setting = None
-            else:
-                self._mesh = self._mesh_setting
+            from igloo_tpu.parallel.mesh import resolve_mesh
+            self._mesh = resolve_mesh(self._mesh_setting)
+            if self._mesh is None:
+                self._mesh_setting = None
         return self._mesh
 
     def _executor(self) -> Executor:

@@ -193,10 +193,14 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         if rk.null is not None:
             ok = ok & ~jnp.take(rk.null, r_idx)
 
+    # --- gather both sides once (the residual env and the output columns
+    # share the same indices; SEMI/ANTI never read these and XLA prunes the
+    # dead gathers from their traces) ---
+    l_cols = K.gather_batch(left, probe_idx)
+    r_cols = K.gather_batch(right, r_idx)
+
     # --- residual predicate over combined row ---
     if residual is not None:
-        l_cols = K.gather_batch(left, probe_idx)
-        r_cols = K.gather_batch(right, r_idx)
         env = Env([c.values for c in l_cols] + [c.values for c in r_cols],
                   [c.nulls for c in l_cols] + [c.nulls for c in r_cols], consts)
         rv, rn = residual.fn(env)
@@ -235,15 +239,12 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         # _rewrite_in_subquery), not here — plain anti is correct as-is
         return DeviceBatch(out_schema, left.columns, left.live & ~l_matched)
 
-    # --- inner part: verified expanded rows, compacted to front ---
-    inner_perm = K.compact_perm(ok)
-    inner_ok = jnp.take(ok, inner_perm)
-    ip = jnp.take(probe_idx, inner_perm)
-    ir = jnp.take(r_idx, inner_perm)
-    l_cols = K.gather_batch(left, ip)
-    r_cols = K.gather_batch(right, ir)
+    # --- inner part: verified expanded rows, NOT compacted (live rows stay
+    # mask-scattered across the match_cap slots; every downstream operator is
+    # selection-mask aware, and the compaction here was a full match_cap-wide
+    # argsort per join, ~1s at SF1) ---
     parts_cols = [l_cols + r_cols]
-    parts_live = [inner_ok]
+    parts_live = [ok]
 
     if join_type in (JoinType.LEFT, JoinType.FULL):
         lm = left.live & ~l_matched
@@ -279,9 +280,10 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         out_cols.append(DeviceColumn(proto.dtype, vals, nulls, proto.dictionary))
     out_live = jnp.concatenate(parts_live)
     if len(parts_live) > 1:
-        # outer joins: interleave the unmatched parts into contiguous rows;
-        # inner joins skip this — their single part is already compacted, and
-        # the full-width argsort here costs a ~2M-lane sort per join
+        # outer joins: compact the concatenated parts into contiguous rows.
+        # Inner joins skip this — their single part stays MASK-SCATTERED (see
+        # above; anything that later needs compaction, e.g. resize_batch,
+        # must compact first) and the argsort here costs a ~2M-lane sort
         perm = K.compact_perm(out_live)
         out_cols = [DeviceColumn(c.dtype, jnp.take(c.values, perm),
                                  jnp.take(c.nulls, perm)

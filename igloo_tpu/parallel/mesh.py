@@ -25,6 +25,22 @@ from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn, MIN_CAPACITY
 ROWS = "rows"  # the one mesh axis: row-partitioned data parallelism
 
 
+def resolve_mesh(setting) -> Optional[Mesh]:
+    """Shared mesh-resolution rule (QueryEngine, worker daemon): None =
+    single-device; "auto" = row-shard across all local devices when more than
+    one is visible; "default" = the process default (engine.DEFAULT_MESH,
+    which the test suite pins to None so single-device paths keep coverage on
+    the virtual 8-device CPU mesh); a Mesh passes through."""
+    if setting == "default":
+        from igloo_tpu.engine import DEFAULT_MESH
+        setting = DEFAULT_MESH
+    if setting is None:
+        return None
+    if setting == "auto":
+        return make_mesh() if len(jax.devices()) > 1 else None
+    return setting
+
+
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     """A 1-D mesh over `n_devices` (default: all local devices). Row capacity
     bucketing is power-of-two, so meshes of non-power-of-two size are rounded
