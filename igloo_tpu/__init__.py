@@ -32,8 +32,11 @@ _cache_flag = _cache_raw.strip().lower()
 if _cache_flag in ("0", "false", "off", "no", ""):
     _cache_dir = None
 elif _cache_flag in ("1", "true", "on", "yes"):
-    _cache_dir = _os.path.join(_os.path.expanduser("~"), ".cache",
-                               "igloo_tpu_xla")
+    # default: alongside the package tree (XLA creates it on demand and
+    # simply skips caching if the location is unwritable)
+    _cache_dir = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        ".xla_cache")
 else:
     _cache_dir = _cache_raw
 if _cache_dir:
