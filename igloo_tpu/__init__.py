@@ -32,11 +32,14 @@ _cache_flag = _cache_raw.strip().lower()
 if _cache_flag in ("0", "false", "off", "no", ""):
     _cache_dir = None
 elif _cache_flag in ("1", "true", "on", "yes"):
-    # default: alongside the package tree (XLA creates it on demand and
-    # simply skips caching if the location is unwritable)
-    _cache_dir = _os.path.join(
-        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
-        ".xla_cache")
+    # default: alongside the package tree when writable (repo checkouts),
+    # else the user cache dir (pip installs into read-only site-packages)
+    _parent = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    if _os.access(_parent, _os.W_OK):
+        _cache_dir = _os.path.join(_parent, ".xla_cache")
+    else:
+        _cache_dir = _os.path.join(_os.path.expanduser("~"), ".cache",
+                                   "igloo_tpu_xla")
 else:
     _cache_dir = _cache_raw
 if _cache_dir:

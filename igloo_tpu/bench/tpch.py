@@ -182,11 +182,16 @@ def gen_tables(sf: float = 0.01, seed: int = 19980401) -> dict[str, pa.Table]:
     returnflag = np.where(receipt <= _days(1995, 6, 17),
                           np.where(rng.random(n_li) < 0.5, "R", "A"), "N")
     linestatus = np.where(ship > _days(1995, 6, 17), "O", "F")
+    # dbgen rule: a line's supplier is one of the FOUR partsupp suppliers of
+    # its part (same formula as ps_supp above with k = linenumber % 4) — so
+    # lineitem x partsupp on (partkey, suppkey) actually joins (q9/q17/q20)
+    li_k = linenumber % 4
     out["lineitem"] = pa.table({
         "l_orderkey": pa.array(li_order, type=pa.int64()),
         "l_partkey": pa.array(partkey, type=pa.int64()),
-        "l_suppkey": pa.array(((partkey + linenumber) % n_supp) + 1,
-                              type=pa.int64()),
+        "l_suppkey": pa.array(
+            ((partkey + li_k * (n_supp // 4 + 1)) % n_supp) + 1,
+            type=pa.int64()),
         "l_linenumber": pa.array(linenumber, type=pa.int64()),
         "l_quantity": qty,
         "l_extendedprice": extended,

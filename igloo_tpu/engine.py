@@ -81,6 +81,10 @@ class QueryEngine:
         # HBM batch cache: scan results stay device-resident across queries
         # (the real version of the reference's unenforced CacheConfig, gap G7)
         self.batch_cache = BatchCache(cache_budget_bytes)
+        # host-side query-result cache (the reference cache's actual shape:
+        # query -> batches, crates/cache/src/lib.rs:20-56), snapshot-validated
+        from igloo_tpu.exec.result_cache import ResultCache
+        self.result_cache = ResultCache()
         # reference parity: capitalize registered at construction (lib.rs:41-42)
         self.register_udf(UdfDef("capitalize", T.STRING))
 
@@ -93,10 +97,12 @@ class QueryEngine:
         # a replaced provider's id() can be reused by the allocator, so identity
         # tokens alone cannot be trusted across re-registration — evict eagerly
         self.batch_cache.invalidate_table(name.lower())
+        self.result_cache.invalidate_table(name)
 
     def deregister_table(self, name: str) -> None:
         self.catalog.deregister(name)
         self.batch_cache.invalidate_table(name.lower())
+        self.result_cache.invalidate_table(name)
 
     def register_udf(self, udf: UdfDef) -> None:
         self.udfs[udf.name.lower()] = udf
@@ -149,7 +155,10 @@ class QueryEngine:
         if isinstance(stmt, A.DropTableStmt):
             if stmt.name.lower() not in self.catalog and not stmt.if_exists:
                 raise CatalogError(f"table not found: {stmt.name}")
-            self.catalog.deregister(stmt.name)
+            if stmt.name.lower() in self.catalog:
+                # full deregistration: evicts the table's HBM batches and any
+                # cached results sourced from it
+                self.deregister_table(stmt.name)
             return QueryResult(pa.table({"status": [f"dropped {stmt.name}"]}),
                                elapsed_s=time.perf_counter() - t0)
         if isinstance(stmt, A.SelectStmt):
@@ -178,9 +187,15 @@ class QueryEngine:
 
     def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
         from igloo_tpu.exec.chunked import LocalChunkExecutor, chunk_count
+        from igloo_tpu.exec.result_cache import plan_cache_key
         with span("bind+optimize"):
             bound = Binder(self.catalog, udfs=self.udfs).bind(stmt)
             plan = optimize(bound)
+        rkey = plan_cache_key(plan)
+        if rkey is not None:
+            hit = self.result_cache.get(rkey)
+            if hit is not None:
+                return (hit, plan) if want_plan else hit
         chunks = chunk_count(plan, self.chunk_budget_bytes)
         if chunks:
             ex = LocalChunkExecutor(self.catalog, self._jit_cache,
@@ -191,6 +206,8 @@ class QueryEngine:
             ex = self._executor()
         with span("execute"):
             table = ex.execute_to_arrow(plan)
+        if rkey is not None:
+            self.result_cache.put(rkey, table)
         if want_plan:
             return table, plan
         return table

@@ -30,67 +30,75 @@ def scan_table_key(name: str) -> str:
 
 @dataclass
 class CacheEntry:
-    batch: DeviceBatch
+    value: object          # DeviceBatch (BatchCache) / pa.Table (ResultCache)
     snapshot: object
     nbytes: int
+    tables: frozenset = frozenset()  # scanned tables (invalidate_table match)
 
 
-class BatchCache:
-    """Thread-safe LRU over device batches, keyed by
-    (table, projection, pushed-filter fingerprint). A stored snapshot token is
-    compared on every hit; a mismatch drops the entry (source changed)."""
+class SnapshotLRU:
+    """Thread-safe byte-budget LRU with snapshot validation — the shared core
+    of the HBM scan cache (BatchCache) and the host query-result cache
+    (exec/result_cache.ResultCache). Subclasses set `counter_prefix` and
+    `_match_table` (how invalidate_table selects entries)."""
+
+    counter_prefix = "cache"
 
     def __init__(self, budget_bytes: int = 1 << 30):
         self.budget_bytes = int(budget_bytes)
-        self._entries: OrderedDict[tuple, CacheEntry] = OrderedDict()
+        self._entries: OrderedDict = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
-    def get(self, key: tuple, snapshot: object) -> Optional[DeviceBatch]:
+    def get(self, key, snapshot: object):
         with self._lock:
             e = self._entries.get(key)
             if e is None:
                 self.misses += 1
-                counter("cache.miss")
+                counter(f"{self.counter_prefix}.miss")
                 return None
             if e.snapshot != snapshot:
                 # source changed underneath us: invalidate
                 self._bytes -= e.nbytes
                 del self._entries[key]
                 self.misses += 1
-                counter("cache.invalidated")
+                counter(f"{self.counter_prefix}.invalidated")
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
-            counter("cache.hit")
-            return e.batch
+            counter(f"{self.counter_prefix}.hit")
+            return e.value
 
-    def put(self, key: tuple, batch: DeviceBatch, snapshot: object) -> None:
-        nbytes = batch.nbytes()
+    def put(self, key, value, snapshot: object, nbytes: int,
+            tables: frozenset = frozenset()) -> None:
         if nbytes > self.budget_bytes:
             return  # larger than the whole budget: never cacheable
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
-            self._entries[key] = CacheEntry(batch, snapshot, nbytes)
+            self._entries[key] = CacheEntry(value, snapshot, nbytes, tables)
             self._bytes += nbytes
             while self._bytes > self.budget_bytes and self._entries:
                 _, ev = self._entries.popitem(last=False)
                 self._bytes -= ev.nbytes
                 self.evictions += 1
-                counter("cache.evict")
+                counter(f"{self.counter_prefix}.evict")
+
+    def _match_table(self, key, entry: CacheEntry, table_key: str) -> bool:
+        raise NotImplementedError
 
     def invalidate_table(self, table: str) -> int:
-        """Drop every cached batch for `table` (CDC invalidation bus entry
+        """Drop every entry sourced from `table` (CDC invalidation bus entry
         point). Returns the number of entries dropped. `table` may be a
         qualified catalog name; it is canonicalized to the scan key."""
-        key = scan_table_key(table)
+        tk = scan_table_key(table)
         with self._lock:
-            doomed = [k for k in self._entries if k and k[0] == key]
+            doomed = [k for k, e in self._entries.items()
+                      if self._match_table(k, e, tk)]
             for k in doomed:
                 self._bytes -= self._entries.pop(k).nbytes
             return len(doomed)
@@ -106,6 +114,19 @@ class BatchCache:
 
     def __len__(self) -> int:
         return len(self._entries)
+
+
+class BatchCache(SnapshotLRU):
+    """HBM scan cache: DeviceBatch values keyed by
+    (table, projection, pushed-filter fingerprint, partition)."""
+
+    counter_prefix = "cache"
+
+    def put(self, key: tuple, batch: DeviceBatch, snapshot: object) -> None:
+        super().put(key, batch, snapshot, batch.nbytes())
+
+    def _match_table(self, key, entry, table_key: str) -> bool:
+        return bool(key) and key[0] == table_key
 
 
 def provider_snapshot(provider) -> object:

@@ -1,0 +1,98 @@
+"""Query-result cache: plan fingerprint -> host Arrow table.
+
+This is the reference cache's actual shape — `Cache` maps query strings to
+RecordBatch vectors (crates/cache/src/lib.rs:20-56) — layered ABOVE the HBM
+scan cache (exec/cache.py): a repeated query skips parsing nothing (the plan
+fingerprint needs the bind) but skips ALL device execution. Entries are
+validated against the snapshot tokens of every scanned provider — including
+scans inside scalar subqueries — so source changes invalidate exactly like
+the scan cache; byte-budget LRU bounds memory (the reference's
+CacheConfig.capacity is declared and never enforced, G7).
+
+Keys are the serialized bound plan (cluster/serde.py), not the SQL text: two
+spellings of the same plan share an entry, and unserializable plans simply
+skip the cache.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+import pyarrow as pa
+
+from igloo_tpu.exec.cache import SnapshotLRU
+
+
+def _collect_scans(plan, tables: list, snaps: list) -> None:
+    """Every Scan in the plan tree AND in scalar-subquery plans embedded in
+    its expressions (walk_plan never descends into expressions, but a
+    subquery's source changing must invalidate the cached result too)."""
+    from igloo_tpu.exec.cache import provider_snapshot, scan_table_key
+    from igloo_tpu.plan import expr as E
+    from igloo_tpu.plan import logical as L
+    for node in L.walk_plan(plan):
+        if isinstance(node, L.Scan) and node.provider is not None:
+            tables.append(scan_table_key(node.table))
+            snaps.append(provider_snapshot(node.provider))
+        for e in _node_exprs(node):
+            if e is None:
+                continue
+            for n in E.walk(e):
+                if isinstance(n, E.ScalarSubquery) and \
+                        isinstance(n.query, L.LogicalPlan):
+                    _collect_scans(n.query, tables, snaps)
+
+
+def _node_exprs(node) -> list:
+    from igloo_tpu.plan import logical as L
+    if isinstance(node, L.Scan):
+        return list(node.pushed_filters)
+    if isinstance(node, L.Filter):
+        return [node.predicate]
+    if isinstance(node, L.Project):
+        return list(node.exprs)
+    if isinstance(node, L.Aggregate):
+        return list(node.group_exprs) + list(node.aggs)
+    if isinstance(node, L.Join):
+        return list(node.left_keys) + list(node.right_keys) + [node.residual]
+    if isinstance(node, L.Sort):
+        return list(node.keys)
+    return []
+
+
+def plan_cache_key(plan) -> Optional[tuple]:
+    """(digest, scanned tables, snapshot tokens) for a bound plan, or None if
+    the plan can't be fingerprinted (unserializable node)."""
+    from igloo_tpu.cluster import serde
+    try:
+        blob = json.dumps(serde.plan_to_json(plan), sort_keys=True,
+                          default=str)
+    except Exception:
+        return None
+    tables: list = []
+    snaps: list = []
+    _collect_scans(plan, tables, snaps)
+    digest = hashlib.sha256(blob.encode()).hexdigest()
+    return digest, tuple(tables), tuple(snaps)
+
+
+class ResultCache(SnapshotLRU):
+    """Host-side result cache over the shared snapshot-validated LRU."""
+
+    counter_prefix = "result_cache"
+
+    def __init__(self, budget_bytes: int = 256 << 20):
+        super().__init__(budget_bytes)
+
+    def get(self, key: tuple) -> Optional[pa.Table]:  # type: ignore[override]
+        digest, _tables, snaps = key
+        return super().get(digest, snaps)
+
+    def put(self, key: tuple, table: pa.Table) -> None:  # type: ignore[override]
+        digest, tables, snaps = key
+        super().put(digest, table, snaps, table.nbytes,
+                    tables=frozenset(tables))
+
+    def _match_table(self, key, entry, table_key: str) -> bool:
+        return table_key in entry.tables
