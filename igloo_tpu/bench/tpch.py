@@ -55,9 +55,41 @@ _WORDS = ("the quick final pending special express regular furious ironic "
 
 
 def _comments(rng, n, lo=2, hi=6):
+    """Random word-pool comments. Above _POOL_N rows, sample from a pregenerated
+    pool instead of building n python strings — vectorized path for SF >= 1
+    (60M-row lineitem at SF10 would spend minutes in a python join loop). The
+    pool preserves the LIKE-able patterns (q13 '%special%requests%', q16
+    '%pending%', q9 '%green%') because it draws from the same word pool."""
+    if n > _POOL_N:
+        pool = np.asarray(_comments_exact(rng, _POOL_N, lo, hi), dtype=object)
+        return pool[rng.integers(0, _POOL_N, n)]
+    return _comments_exact(rng, n, lo, hi)
+
+
+_POOL_N = 50_000
+
+
+def _comments_exact(rng, n, lo, hi):
     k = rng.integers(lo, hi + 1, n)
     idx = rng.integers(0, len(_WORDS), (n, hi))
     return [" ".join(_WORDS[idx[i, j]] for j in range(k[i])) for i in range(n)]
+
+
+def _fmt(pattern: str, arr: np.ndarray) -> np.ndarray:
+    """Vectorized sprintf over an int array (np.char.mod; no python loop)."""
+    return np.char.mod(pattern, arr)
+
+
+def _pick(choices: list, rng, n) -> np.ndarray:
+    return np.asarray(choices, dtype=object)[rng.integers(0, len(choices), n)]
+
+
+def _phones(rng, nation: np.ndarray) -> list:
+    n = len(nation)
+    return np.char.add(np.char.add(np.char.add(
+        _fmt("%d-", nation + 10), _fmt("%d-", rng.integers(100, 999, n))),
+        _fmt("%d-", rng.integers(100, 999, n))),
+        _fmt("%d", rng.integers(1000, 9999, n))).tolist()
 
 
 def _money(rng, n, lo, hi):
@@ -88,31 +120,33 @@ def gen_tables(sf: float = 0.01, seed: int = 19980401) -> dict[str, pa.Table]:
     s_nation = rng.integers(0, n_nation, n_supp)
     out["supplier"] = pa.table({
         "s_suppkey": pa.array(np.arange(1, n_supp + 1), type=pa.int64()),
-        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+        "s_name": _fmt("Supplier#%09d", np.arange(1, n_supp + 1)).tolist(),
         "s_address": _comments(rng, n_supp, 1, 3),
         "s_nationkey": pa.array(s_nation, type=pa.int64()),
-        "s_phone": [f"{10 + s_nation[i]}-{rng.integers(100, 999)}-"
-                    f"{rng.integers(100, 999)}-{rng.integers(1000, 9999)}"
-                    for i in range(n_supp)],
+        "s_phone": _phones(rng, s_nation),
         "s_acctbal": _money(rng, n_supp, -999.99, 9999.99),
         "s_comment": _comments(rng, n_supp),
     })
 
     n_part = max(int(200_000 * sf), 20)
-    p_types = [f"{_TYPES_P1[rng.integers(0, 6)]} "
-               f"{_TYPES_P2[rng.integers(0, 5)]} "
-               f"{_TYPES_P3[rng.integers(0, 5)]}" for _ in range(n_part)]
+    p_types = np.char.add(np.char.add(
+        np.char.add(_pick(_TYPES_P1, rng, n_part).astype(str), " "),
+        np.char.add(_pick(_TYPES_P2, rng, n_part).astype(str), " ")),
+        _pick(_TYPES_P3, rng, n_part).astype(str)).tolist()
     out["part"] = pa.table({
         "p_partkey": pa.array(np.arange(1, n_part + 1), type=pa.int64()),
-        "p_name": [" ".join(rng.choice(_WORDS, 3)) for _ in range(n_part)],
-        "p_mfgr": [f"Manufacturer#{rng.integers(1, 6)}" for _ in range(n_part)],
-        "p_brand": [f"Brand#{rng.integers(1, 6)}{rng.integers(1, 6)}"
-                    for _ in range(n_part)],
+        "p_name": np.char.add(np.char.add(
+            np.char.add(_pick(_WORDS, rng, n_part).astype(str), " "),
+            np.char.add(_pick(_WORDS, rng, n_part).astype(str), " ")),
+            _pick(_WORDS, rng, n_part).astype(str)).tolist(),
+        "p_mfgr": _fmt("Manufacturer#%d", rng.integers(1, 6, n_part)).tolist(),
+        "p_brand": np.char.add(_fmt("Brand#%d", rng.integers(1, 6, n_part)),
+                               _fmt("%d", rng.integers(1, 6, n_part))).tolist(),
         "p_type": p_types,
         "p_size": pa.array(rng.integers(1, 51, n_part), type=pa.int64()),
-        "p_container": [f"{_CONTAINERS_P1[rng.integers(0, 5)]} "
-                        f"{_CONTAINERS_P2[rng.integers(0, 8)]}"
-                        for _ in range(n_part)],
+        "p_container": np.char.add(
+            np.char.add(_pick(_CONTAINERS_P1, rng, n_part).astype(str), " "),
+            _pick(_CONTAINERS_P2, rng, n_part).astype(str)).tolist(),
         "p_retailprice": _money(rng, n_part, 900.0, 2000.0),
         "p_comment": _comments(rng, n_part, 1, 3),
     })
@@ -133,14 +167,12 @@ def gen_tables(sf: float = 0.01, seed: int = 19980401) -> dict[str, pa.Table]:
     c_nation = rng.integers(0, n_nation, n_cust)
     out["customer"] = pa.table({
         "c_custkey": pa.array(np.arange(1, n_cust + 1), type=pa.int64()),
-        "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+        "c_name": _fmt("Customer#%09d", np.arange(1, n_cust + 1)).tolist(),
         "c_address": _comments(rng, n_cust, 1, 3),
         "c_nationkey": pa.array(c_nation, type=pa.int64()),
-        "c_phone": [f"{10 + c_nation[i]}-{rng.integers(100, 999)}-"
-                    f"{rng.integers(100, 999)}-{rng.integers(1000, 9999)}"
-                    for i in range(n_cust)],
+        "c_phone": _phones(rng, c_nation),
         "c_acctbal": _money(rng, n_cust, -999.99, 9999.99),
-        "c_mktsegment": [_SEGMENTS[i] for i in rng.integers(0, 5, n_cust)],
+        "c_mktsegment": _pick(_SEGMENTS, rng, n_cust).tolist(),
         "c_comment": _comments(rng, n_cust),
     })
 
@@ -153,12 +185,12 @@ def gen_tables(sf: float = 0.01, seed: int = 19980401) -> dict[str, pa.Table]:
     out["orders"] = pa.table({
         "o_orderkey": pa.array(np.arange(1, n_ord + 1), type=pa.int64()),
         "o_custkey": pa.array(o_cust, type=pa.int64()),
-        "o_orderstatus": [["F", "O", "P"][i] for i in rng.integers(0, 3, n_ord)],
+        "o_orderstatus": _pick(["F", "O", "P"], rng, n_ord).tolist(),
         "o_totalprice": _money(rng, n_ord, 800.0, 500_000.0),
         "o_orderdate": pa.array(o_date.astype("int32"), type=pa.int32()).cast(
             pa.date32()),
-        "o_orderpriority": [_PRIORITIES[i] for i in rng.integers(0, 5, n_ord)],
-        "o_clerk": [f"Clerk#{rng.integers(1, 1001):09d}" for _ in range(n_ord)],
+        "o_orderpriority": _pick(_PRIORITIES, rng, n_ord).tolist(),
+        "o_clerk": _fmt("Clerk#%09d", rng.integers(1, 1001, n_ord)).tolist(),
         "o_shippriority": pa.array(np.zeros(n_ord, dtype=np.int64)),
         "o_comment": _comments(rng, n_ord),
     })
@@ -205,8 +237,8 @@ def gen_tables(sf: float = 0.01, seed: int = 19980401) -> dict[str, pa.Table]:
             pa.date32()),
         "l_receiptdate": pa.array(receipt.astype("int32"),
                                   type=pa.int32()).cast(pa.date32()),
-        "l_shipinstruct": [_INSTRUCTIONS[i] for i in rng.integers(0, 4, n_li)],
-        "l_shipmode": [_SHIPMODES[i] for i in rng.integers(0, 7, n_li)],
+        "l_shipinstruct": _pick(_INSTRUCTIONS, rng, n_li).tolist(),
+        "l_shipmode": _pick(_SHIPMODES, rng, n_li).tolist(),
         "l_comment": _comments(rng, n_li, 1, 3),
     })
     return out

@@ -16,6 +16,7 @@ import pyarrow.flight as flight
 from igloo_tpu.errors import IglooError
 
 
+from igloo_tpu.cluster.rpc import call_options as _call_options
 from igloo_tpu.cluster.rpc import normalize as _normalize
 
 
@@ -45,7 +46,8 @@ class DistributedClient:
     def execute(self, sql: str) -> pa.Table:
         """One round trip: the ticket IS the SQL (do_get executes once)."""
         try:
-            reader = self._client.do_get(flight.Ticket(sql.encode()))
+            reader = self._client.do_get(flight.Ticket(sql.encode()),
+                                         _call_options())
             return reader.read_all()
         except flight.FlightError as ex:
             raise IglooError(_strip_flight(str(ex))) from None
@@ -57,7 +59,7 @@ class DistributedClient:
         answer this — crates/api/src/lib.rs:90-98)."""
         desc = flight.FlightDescriptor.for_command(sql.encode())
         try:
-            return self._client.get_schema(desc).schema
+            return self._client.get_schema(desc, _call_options()).schema
         except flight.FlightError as ex:
             raise IglooError(_strip_flight(str(ex))) from None
 
@@ -66,7 +68,7 @@ class DistributedClient:
     def register_table(self, name: str, table: pa.Table) -> None:
         """Upload an in-memory table (Flight do_put; reference: unimplemented)."""
         desc = flight.FlightDescriptor.for_path(name)
-        writer, _ = self._client.do_put(desc, table.schema)
+        writer, _ = self._client.do_put(desc, table.schema, _call_options())
         writer.write_table(table)
         writer.close()
 
@@ -86,7 +88,8 @@ class DistributedClient:
     def _action(self, name: str, payload: Optional[dict] = None) -> dict:
         body = json.dumps(payload).encode() if payload is not None else b""
         try:
-            results = list(self._client.do_action(flight.Action(name, body)))
+            results = list(self._client.do_action(flight.Action(name, body),
+                                                  _call_options()))
         except flight.FlightError as ex:
             raise IglooError(_strip_flight(str(ex))) from None
         return json.loads(results[0].body.to_pybytes()) if results else {}

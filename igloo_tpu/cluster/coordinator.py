@@ -31,7 +31,7 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.flight as flight
 
-from igloo_tpu.cluster import serde
+from igloo_tpu.cluster import rpc, serde
 from igloo_tpu.cluster.fragment import DistributedPlanner, QueryFragment
 from igloo_tpu.cluster.rpc import flight_action, flight_get_table
 from igloo_tpu.engine import QueryEngine
@@ -255,6 +255,13 @@ class CoordinatorServer(flight.FlightServerBase):
     def __init__(self, location: str, worker_timeout_s: float = 15.0,
                  use_jit: bool = True, advertise_host: Optional[str] = None,
                  **kw):
+        # trusted-network default; IGLOO_TPU_AUTH_TOKEN installs a shared-
+        # token check on every Flight call (see cluster/rpc.py security model)
+        mw = rpc.server_middleware()
+        if mw is not None:
+            kw.setdefault("middleware", mw)
+        rpc.warn_if_open_bind(location.split("://")[-1].rsplit(":", 1)[0],
+                              "coordinator")
         super().__init__(location, **kw)
         if advertise_host is None:
             # endpoint host clients are told to come back to: the bound host

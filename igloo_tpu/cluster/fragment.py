@@ -80,11 +80,19 @@ def _subtree_scan(p: L.LogicalPlan) -> Optional[L.Scan]:
 
 
 def _with_partition(p: L.LogicalPlan, part: tuple[int, ...]) -> L.LogicalPlan:
-    """Copy of the subtree with its scan restricted to `part`."""
+    """Copy of the subtree with its scan restricted to `part`, capturing the
+    provider's partition-index fingerprint so reads fail loudly if the index
+    is rebuilt (re-glob) between planning and execution."""
     n = L.copy_plan(p)
     sc = _subtree_scan(n)
     assert sc is not None
     sc.partition = part
+    tok = getattr(sc.provider, "partition_token", None)
+    if tok is not None:
+        try:
+            sc.partition_token = tok()
+        except Exception:
+            sc.partition_token = None
     return n
 
 

@@ -1,10 +1,75 @@
-"""Shared Flight RPC plumbing for the cluster package."""
+"""Shared Flight RPC plumbing for the cluster package.
+
+SECURITY MODEL: the cluster transports are designed for a TRUSTED network.
+Control actions (register_table, do_put) accept provider specs naming
+filesystem paths, so anyone who can reach the port can read files the process
+can. The defaults bind loopback only; before binding a non-loopback host set
+IGLOO_TPU_AUTH_TOKEN on every process (coordinator, workers, clients) — all
+Flight calls then carry the token in an `x-igloo-token` header and servers
+reject calls without it. The token is a shared secret over plaintext gRPC:
+it gates access, it is not wire encryption; use a private network or mTLS
+termination in front for anything stronger.
+"""
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional
 
 import pyarrow.flight as flight
+
+AUTH_TOKEN_ENV = "IGLOO_TPU_AUTH_TOKEN"
+_HEADER = "x-igloo-token"
+
+
+def auth_token() -> Optional[str]:
+    return os.environ.get(AUTH_TOKEN_ENV) or None
+
+
+def call_options() -> Optional[flight.FlightCallOptions]:
+    """FlightCallOptions carrying the shared token (None when unset)."""
+    tok = auth_token()
+    if tok is None:
+        return None
+    return flight.FlightCallOptions(
+        headers=[(_HEADER.encode(), tok.encode())])
+
+
+class TokenMiddlewareFactory(flight.ServerMiddlewareFactory):
+    """Rejects any call not presenting the shared token."""
+
+    def __init__(self, token: str):
+        self._token = token
+
+    def start_call(self, info, headers):
+        vals = []
+        for k, vs in headers.items():
+            key = k.decode() if isinstance(k, bytes) else k
+            if key.lower() == _HEADER:
+                vals.extend(v.decode() if isinstance(v, bytes) else v
+                            for v in vs)
+        if self._token not in vals:
+            raise flight.FlightUnauthenticatedError(
+                "missing or invalid x-igloo-token (set IGLOO_TPU_AUTH_TOKEN)")
+        return None
+
+
+def server_middleware() -> Optional[dict]:
+    """Middleware dict for FlightServerBase when a token is configured."""
+    tok = auth_token()
+    if tok is None:
+        return None
+    return {"auth": TokenMiddlewareFactory(tok)}
+
+
+def warn_if_open_bind(host: str, what: str) -> None:
+    if host.strip("[]") not in ("127.0.0.1", "localhost", "::1") \
+            and auth_token() is None:
+        import sys
+        print(f"WARNING: {what} binding non-loopback host {host} with NO "
+              f"auth token; anyone reaching the port can register tables "
+              f"over arbitrary local paths. Set {AUTH_TOKEN_ENV}.",
+              file=sys.stderr)
 
 
 def normalize(addr: str) -> str:
@@ -17,7 +82,8 @@ def flight_action(addr: str, name: str, payload: Optional[dict] = None) -> dict:
     client = flight.connect(normalize(addr))
     try:
         body = json.dumps(payload).encode() if payload is not None else b""
-        results = list(client.do_action(flight.Action(name, body)))
+        results = list(client.do_action(flight.Action(name, body),
+                                        call_options()))
     finally:
         client.close()
     return json.loads(results[0].body.to_pybytes()) if results else {}
@@ -27,6 +93,7 @@ def flight_get_table(addr: str, ticket: str):
     """One-shot do_get RPC returning the full Arrow table."""
     client = flight.connect(normalize(addr))
     try:
-        return client.do_get(flight.Ticket(ticket.encode())).read_all()
+        return client.do_get(flight.Ticket(ticket.encode()),
+                             call_options()).read_all()
     finally:
         client.close()

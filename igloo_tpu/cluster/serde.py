@@ -153,7 +153,8 @@ def plan_to_json(p: L.LogicalPlan) -> dict:
     if isinstance(p, L.Scan):
         d.update(table=p.table, projection=p.projection,
                  pushed=[expr_to_json(f) for f in p.pushed_filters],
-                 partition=getattr(p, "partition", None))
+                 partition=getattr(p, "partition", None),
+                 partition_token=getattr(p, "partition_token", None))
     elif isinstance(p, L.Filter):
         d.update(input=plan_to_json(p.input), predicate=expr_to_json(p.predicate))
     elif isinstance(p, L.Project):
@@ -201,6 +202,7 @@ def plan_from_json(d: dict, catalog) -> L.LogicalPlan:
             pushed_filters=[expr_from_json(f) for f in d["pushed"]])
         if d.get("partition") is not None:
             p.partition = tuple(d["partition"])  # type: ignore[attr-defined]
+        p.partition_token = d.get("partition_token")  # type: ignore[attr-defined]
     elif t == "Filter":
         p = L.Filter(input=plan_from_json(d["input"], catalog),
                      predicate=_rx(d["predicate"], catalog))

@@ -28,6 +28,7 @@ import pyarrow.flight as flight
 from igloo_tpu.catalog import Catalog, MemTable
 from igloo_tpu.cluster import serde
 from igloo_tpu.cluster.fragment import FRAG_PREFIX
+from igloo_tpu.cluster import rpc
 from igloo_tpu.cluster.rpc import flight_action, flight_get_table
 from igloo_tpu.cluster.rpc import normalize as _normalize
 from igloo_tpu.errors import IglooError
@@ -54,6 +55,11 @@ class WorkerServer(flight.FlightServerBase):
 
     def __init__(self, location: str, worker_id: Optional[str] = None,
                  use_jit: bool = True, mesh: object = "default", **kw):
+        mw = rpc.server_middleware()
+        if mw is not None:
+            kw.setdefault("middleware", mw)
+        rpc.warn_if_open_bind(location.split("://")[-1].rsplit(":", 1)[0],
+                              "worker")
         super().__init__(location, **kw)
         self.worker_id = worker_id or uuid.uuid4().hex[:12]
         self.advertise: str = location

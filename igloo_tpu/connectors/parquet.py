@@ -77,6 +77,16 @@ class ParquetTable:
     def num_partitions(self) -> int:
         return len(self._partition_index())
 
+    def partition_token(self) -> str:
+        """Stable fingerprint of the (file, row_group) partition index. Plans
+        capture it at planning time; read_scan_table verifies it before
+        partitioned reads, so an index rebuilt mid-query (snapshot() re-glob
+        after a file replace) errors instead of silently reading wrong rows
+        when only the layout — not the length — changed."""
+        import hashlib
+        parts = self._partition_index()
+        return hashlib.sha1(repr(parts).encode()).hexdigest()
+
     def estimated_bytes(self) -> Optional[int]:
         return files_bytes(self._files)
 

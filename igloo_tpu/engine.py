@@ -26,6 +26,7 @@ from igloo_tpu.plan.binder import Binder
 from igloo_tpu.plan.optimizer import optimize
 from igloo_tpu.sql import ast as A
 from igloo_tpu.sql.parser import parse_sql
+from igloo_tpu.utils import tracing
 from igloo_tpu.utils.tracing import span
 
 
@@ -85,6 +86,10 @@ class QueryEngine:
         # query -> batches, crates/cache/src/lib.rs:20-56), snapshot-validated
         from igloo_tpu.exec.result_cache import ResultCache
         self.result_cache = ResultCache()
+        # persistent cardinality hints for adaptive fused execution (beside the
+        # XLA compile cache, so a fresh process compiles hinted programs first)
+        from igloo_tpu.exec.hints import default_store
+        self.hint_store = default_store()
         # reference parity: capitalize registered at construction (lib.rs:41-42)
         self.register_udf(UdfDef("capitalize", T.STRING))
 
@@ -183,7 +188,7 @@ class QueryEngine:
             return ShardedExecutor(self._jit_cache, use_jit=self._use_jit,
                                    batch_cache=self.batch_cache, mesh=mesh)
         return Executor(self._jit_cache, use_jit=self._use_jit,
-                        batch_cache=self.batch_cache)
+                        batch_cache=self.batch_cache, hints=self.hint_store)
 
     def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
         from igloo_tpu.exec.chunked import LocalChunkExecutor, chunk_count
@@ -196,8 +201,13 @@ class QueryEngine:
             hit = self.result_cache.get(rkey)
             if hit is not None:
                 return (hit, plan) if want_plan else hit
-        chunks = chunk_count(plan, self.chunk_budget_bytes)
+        # a resolved multi-chip mesh takes precedence over single-device
+        # chunking: the sharded executor already bounds per-chip memory by
+        # row-sharding, and silently chunking would discard the parallelism
+        chunks = 0 if self._resolve_mesh() is not None else \
+            chunk_count(plan, self.chunk_budget_bytes)
         if chunks:
+            tracing.counter("engine.chunked_route")
             ex = LocalChunkExecutor(self.catalog, self._jit_cache,
                                     use_jit=self._use_jit,
                                     batch_cache=self.batch_cache,

@@ -181,6 +181,11 @@ class DeviceColumn:
     values: jax.Array              # [capacity], device dtype per DataType.device_dtype
     nulls: Optional[jax.Array]     # [capacity] bool, True = null; None = no nulls
     dictionary: Optional[DictInfo] = None  # STRING columns only
+    # host-side (lo, hi) value bounds for integer-family columns, computed at
+    # scan time and propagated (never widened) through filters/joins/sorts.
+    # Powers the direct "array join" fast path (exec/join.py direct_join):
+    # dense PK-FK joins become scatter+gather instead of sorts. None = unknown.
+    bounds: Optional[tuple] = None
 
     @property
     def capacity(self) -> int:
@@ -234,8 +239,9 @@ class DeviceBatch:
 
 jax.tree_util.register_pytree_node(
     DeviceColumn,
-    lambda c: ((c.values, c.nulls), (c.dtype, c.dictionary)),
-    lambda aux, ch: DeviceColumn(aux[0], ch[0], ch[1], aux[1]),
+    lambda c: ((c.values, c.nulls), (c.dtype, c.dictionary, c.bounds)),
+    lambda aux, ch: DeviceColumn(aux[0], ch[0], ch[1], aux[1],
+                                 aux[2] if len(aux) > 2 else None),
 )
 
 jax.tree_util.register_pytree_node(
@@ -345,9 +351,20 @@ def _encode_string_column(arr: pa.ChunkedArray, dict_info: Optional[DictInfo]):
         ids = np.clip(ids, 0, len(dict_info) - 1)
         ok = dstr[ids] == safe.astype(str)
     else:
-        index = {v: i for i, v in enumerate(dict_info.values.tolist())}
-        ids = np.asarray([index.get(v, 0) for v in safe], dtype=np.int32)
-        ok = np.asarray([v in index for v in safe], dtype=bool)
+        # vectorized lookup against an UNSORTED dictionary: binary-search the
+        # rank-ordered values (ranks() caches the sort) instead of a per-row
+        # python dict probe — O(rows log uniques) in numpy C, not an
+        # interpreter loop over millions of rows
+        ranks = dict_info.ranks()
+        order = np.empty(len(ranks), dtype=np.int64)
+        order[ranks] = np.arange(len(ranks))
+        dstr = dict_info.values.astype(str)
+        sorted_vals = dstr[order]
+        svals = safe.astype(str)
+        pos = np.clip(np.searchsorted(sorted_vals, svals), 0,
+                      len(sorted_vals) - 1)
+        ok = sorted_vals[pos] == svals
+        ids = np.where(ok, order[pos], 0).astype(np.int32)
     if null_mask is not None:
         ok = ok | null_mask
     if not ok.all():
@@ -370,6 +387,21 @@ def _arrow_column_to_numpy(arr: pa.ChunkedArray, dtype: DataType):
     np_vals = combined.to_numpy(zero_copy_only=False)
     np_vals = np.asarray(np_vals).astype(dtype.device_dtype(), copy=False)
     return np_vals, null_mask
+
+
+_BOUNDED_IDS = (TypeId.INT32, TypeId.INT64, TypeId.DATE32, TypeId.TIMESTAMP)
+
+
+def _int_bounds(np_vals: np.ndarray, null_mask, dtype: DataType):
+    """(min, max) over non-null values of an integer-family column; None when
+    the column is empty, all-null, or not integer-typed. Host-side stats that
+    ride DeviceColumn.bounds into the planner's join-strategy choice."""
+    if dtype.id not in _BOUNDED_IDS or len(np_vals) == 0:
+        return None
+    valid = np_vals if null_mask is None else np_vals[~null_mask]
+    if len(valid) == 0:
+        return None
+    return (int(valid.min()), int(valid.max()))
 
 
 def _pad(a: np.ndarray, capacity: int) -> np.ndarray:
@@ -407,13 +439,14 @@ def from_arrow(
             cols.append(DeviceColumn(f.dtype, dev_vals, nulls, dinfo))
         else:
             np_vals, null_mask = _arrow_column_to_numpy(arr, f.dtype)
+            bounds = _int_bounds(np_vals, null_mask, f.dtype)
             vals = _pad(np_vals, cap)
             dev_vals = jnp.asarray(vals) if device is None else jax.device_put(vals, device)
             nulls = None
             if null_mask is not None:
                 nm = _pad(null_mask, cap)
                 nulls = jnp.asarray(nm) if device is None else jax.device_put(nm, device)
-            cols.append(DeviceColumn(f.dtype, dev_vals, nulls, None))
+            cols.append(DeviceColumn(f.dtype, dev_vals, nulls, None, bounds))
     live = np.zeros((cap,), dtype=bool)
     live[:n] = True
     live_dev = jnp.asarray(live) if device is None else jax.device_put(live, device)

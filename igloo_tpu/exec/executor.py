@@ -37,9 +37,10 @@ from igloo_tpu.exec.expr_compile import (
     Compiled, ConstPool, Env, ExprCompiler, _unify_dicts,
 )
 from igloo_tpu.exec.join import (
-    choose_match_capacity, expand_phase, join_batches, make_key_hash_idxs,
-    probe_phase,
+    choose_direct_build, choose_match_capacity, direct_join_phase, expand_phase,
+    join_batches, make_key_hash_idxs, probe_phase,
 )
+from igloo_tpu.exec.fused import FusedCompiler, FusionUnsupported
 from igloo_tpu.exec.sort_limit import limit_batch, sort_batch
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
@@ -57,6 +58,14 @@ def read_scan_table(plan: L.Scan) -> pa.Table:
     if plan.partition is None:
         return plan.provider.read(projection=plan.projection,
                                   filters=plan.pushed_filters)
+    tok_fn = getattr(plan.provider, "partition_token", None)
+    if plan.partition_token is not None and tok_fn is not None:
+        cur = tok_fn()
+        if cur != plan.partition_token:
+            from igloo_tpu.errors import ConnectorError
+            raise ConnectorError(
+                f"partition index for {plan.table} changed since planning "
+                "(source files moved/replaced); re-plan the query")
     parts = [plan.provider.read_partition(i, projection=plan.projection,
                                           filters=plan.pushed_filters)
              for i in plan.partition]
@@ -80,21 +89,30 @@ def expr_fingerprint(exprs) -> str:
 
 
 def strip_dicts(batch: DeviceBatch) -> DeviceBatch:
-    """Drop host-side dictionaries before a batch crosses into jax.jit, so the
-    pytree aux (= compile-cache key) is content-free."""
+    """Drop host-side metadata (dictionaries, bounds) before a batch crosses
+    into jax.jit, so the pytree aux (= compile-cache key) is content-free."""
     from dataclasses import replace
     return DeviceBatch(batch.schema,
-                       [replace(c, dictionary=None) for c in batch.columns],
+                       [replace(c, dictionary=None, bounds=None)
+                        for c in batch.columns],
                        batch.live)
 
 
-def attach_dicts(batch: DeviceBatch, dicts) -> DeviceBatch:
-    """Re-attach per-column dictionaries (host metadata) to a jit output."""
+def attach_dicts(batch: DeviceBatch, dicts, bounds=None) -> DeviceBatch:
+    """Re-attach per-column dictionaries + value bounds (host metadata) to a
+    jit output. `bounds` defaults to all-unknown."""
     from dataclasses import replace
+    if bounds is None:
+        bounds = [None] * len(dicts)
     return DeviceBatch(batch.schema,
-                       [replace(c, dictionary=d)
-                        for c, d in zip(batch.columns, dicts)],
+                       [replace(c, dictionary=d, bounds=b)
+                        for c, d, b in zip(batch.columns, dicts, bounds)],
                        batch.live)
+
+
+def col_meta(cols) -> tuple[list, list]:
+    """(dicts, bounds) of a column list, for attach_dicts after a 1:1 jit."""
+    return [c.dictionary for c in cols], [c.bounds for c in cols]
 
 
 class Executor:
@@ -110,12 +128,13 @@ class Executor:
     _SPECULATIVE_JOIN_BUDGET = 1 << 22
 
     def __init__(self, jit_cache: Optional[dict] = None, use_jit: bool = True,
-                 batch_cache=None, speculate: bool = True):
+                 batch_cache=None, speculate: bool = True, hints=None):
         # shared across queries when the engine passes its own cache dict
         self._cache = jit_cache if jit_cache is not None else {}
         self._use_jit = use_jit
         self._batch_cache = batch_cache  # Optional[BatchCache]
         self._speculate = speculate
+        self._hints = hints  # Optional[HintStore] (persistent nhints)
         self._deferred_overflow: list = []  # device bools, checked at final fetch
 
     # --- cache helpers ---
@@ -139,18 +158,32 @@ class Executor:
     def execute(self, plan: L.LogicalPlan) -> DeviceBatch:
         batch = self._exec(plan)
         if self._deferred_overflow:
-            flags = jax.device_get(self._deferred_overflow)
-            self._deferred_overflow = []
-            if any(bool(f) for f in flags):
+            deferred, self._deferred_overflow = self._deferred_overflow, []
+            vals = jax.device_get([f for _, f in deferred])
+            if self._fired_deferred(deferred, vals):
                 return self._exact_copy().execute(plan)
         return batch
+
+    def _fired_deferred(self, deferred, vals) -> bool:
+        """Check fetched deferred-flag values; record the negative cache for
+        direct joins whose build side proved to have duplicate keys."""
+        fired = False
+        for (tag, _), v in zip(deferred, vals):
+            if bool(v):
+                fired = True
+                if tag[0] == "dup":
+                    jfp_core, side = tag[1]
+                    self._cache[("nodirect", jfp_core, side)] = True
+                    tracing.counter("join.direct_dup_fallback")
+        return fired
 
     def _exact_copy(self) -> "Executor":
         """A sibling executor with speculation off (shares all caches); used to
         re-run a plan after a deferred speculative-join overflow fired."""
         tracing.counter("join.speculation_overflow")
         return Executor(self._cache, use_jit=self._use_jit,
-                        batch_cache=self._batch_cache, speculate=False)
+                        batch_cache=self._batch_cache, speculate=False,
+                        hints=self._hints)
 
     # Above this capacity a final batch is speculatively compacted down to this
     # many lanes before the device->host fetch: most query results fit, so the
@@ -159,16 +192,81 @@ class Executor:
     # On overflow (count > cap) we pay the exact compact + refetch.
     _FINAL_FETCH_CAPACITY = 1 << 10
 
+    # whole-plan fusion (exec/fused.py): one dispatch + one fetch per query.
+    # ShardedExecutor overrides to False (its stages shard_map over a mesh).
+    _FUSE = True
+
     def execute_to_arrow(self, plan: L.LogicalPlan) -> pa.Table:
+        if self._FUSE and self._use_jit and self._speculate:
+            try:
+                return self._fused_to_arrow(plan)
+            except FusionUnsupported as e:
+                tracing.counter("fused.unsupported")
+                tracing.counter(f"fused.unsupported.{e.args[0] if e.args else ''}")
+        return self._staged_to_arrow(plan)
+
+    def _fused_to_arrow(self, plan: L.LogicalPlan, _retry: bool = True) -> pa.Table:
+        """Execute via the fused whole-plan program: one dispatch, one fetch
+        of (deferred flags, cardinality stats, row count, compacted result).
+        Observed live counts update the adaptive capacity hints; a compaction
+        overflow triggers ONE repair re-run with the fresh hints, any other
+        flag (direct-join duplicates, speculative overflow) an exact staged
+        re-run. Oversized results pay an exact compact + full fetch."""
+        from igloo_tpu.exec.batch import arrow_from_host
+        comp = FusedCompiler(self)
+        run, key, meta = comp.compile(plan)
+        jf = self._jitted("fused", key, lambda: run)
+        tracing.counter("fused.execute")
+        big, spec, n_dev, flags, stats = jf(
+            [strip_dicts(b) for b in comp.leaves], comp.pool.device_args())
+        flags_h, stats_h, n, host_live, host_vals, host_nulls = jax.device_get(
+            (flags, stats, n_dev, spec.live, [c.values for c in spec.columns],
+             [c.nulls for c in spec.columns]))
+        for sid, v in stats_h.items():
+            self._cache[("nhint", comp.stat_keys[sid])] = int(v)
+            if self._hints is not None:
+                self._hints.put(comp.stat_keys[sid], int(v))
+        if self._hints is not None:
+            self._hints.flush()
+        fired = [comp.flag_tags[fid] for fid, v in flags_h.items() if bool(v)]
+        if fired:
+            for tag in fired:
+                if tag[0] == "dup":
+                    # negative cache: THIS side of the join proved to have
+                    # duplicate keys — the other side may still direct-join
+                    jfp_core, side = tag[1]
+                    self._cache[("nodirect", jfp_core, side)] = True
+                    tracing.counter("join.direct_dup_fallback")
+            if _retry and all(t[0] == "compact" for t in fired):
+                # stale cardinality hints only: repair with the fresh ones
+                tracing.counter("fused.compact_repair")
+                return self._fused_to_arrow(plan, _retry=False)
+            return self._exact_copy().execute_to_arrow(plan)
+        spec = attach_dicts(spec, meta.dicts, meta.bounds)
+        if int(n) <= spec.capacity:
+            return arrow_from_host(spec, host_live, host_vals, host_nulls)
+        # result larger than the fetch window: exact compact + full fetch
+        want = round_capacity(int(n))
+        fp = ("compact", batch_proto_key(big), want)
+
+        def build():
+            def fn(b):
+                return K.compact_to(b, want)
+            return fn
+        out = self._jitted("compact", fp, build)(big)
+        return to_arrow(attach_dicts(out, meta.dicts, meta.bounds))
+
+    def _staged_to_arrow(self, plan: L.LogicalPlan) -> pa.Table:
         from igloo_tpu.exec.batch import arrow_from_host
         batch = self._exec(plan)
         deferred, self._deferred_overflow = self._deferred_overflow, []
+        dvals = [f for _, f in deferred]
         cap = self._FINAL_FETCH_CAPACITY
         if batch.capacity <= cap:
             flags, host_live, host_vals, host_nulls = jax.device_get(
-                (deferred, batch.live, [c.values for c in batch.columns],
+                (dvals, batch.live, [c.values for c in batch.columns],
                  [c.nulls for c in batch.columns]))
-            if any(bool(f) for f in flags):
+            if self._fired_deferred(deferred, flags):
                 return self._exact_copy().execute_to_arrow(plan)
             return arrow_from_host(batch, host_live, host_vals, host_nulls)
         fp = ("spec_compact", batch_proto_key(batch), cap)
@@ -176,15 +274,14 @@ class Executor:
         def build():
             def fn(b):
                 n = jnp.sum(b.live)
-                return K.resize_batch(
-                    K.apply_perm(b, K.compact_perm(b.live)), cap), n
+                return K.compact_to(b, cap), n
             return fn
         spec, n_dev = self._jitted("spec_compact", fp, build)(strip_dicts(batch))
-        spec = attach_dicts(spec, [c.dictionary for c in batch.columns])
+        spec = attach_dicts(spec, *col_meta(batch.columns))
         flags, host_n, host_live, host_vals, host_nulls = jax.device_get(
-            (deferred, n_dev, spec.live, [c.values for c in spec.columns],
+            (dvals, n_dev, spec.live, [c.values for c in spec.columns],
              [c.nulls for c in spec.columns]))
-        if any(bool(f) for f in flags):
+        if self._fired_deferred(deferred, flags):
             return self._exact_copy().execute_to_arrow(plan)
         if int(host_n) <= cap:
             return arrow_from_host(spec, host_live, host_vals, host_nulls)
@@ -194,11 +291,10 @@ class Executor:
 
         def build_full():
             def fn(b):
-                return K.resize_batch(
-                    K.apply_perm(b, K.compact_perm(b.live)), want)
+                return K.compact_to(b, want)
             return fn
         out = self._jitted("compact", fp, build_full)(strip_dicts(batch))
-        return to_arrow(attach_dicts(out, [c.dictionary for c in batch.columns]))
+        return to_arrow(attach_dicts(out, *col_meta(batch.columns)))
 
     def _exec(self, plan: L.LogicalPlan) -> DeviceBatch:
         m = getattr(self, "_exec_" + type(plan).__name__.lower(), None)
@@ -254,7 +350,8 @@ class Executor:
         scalar-subquery literals, so fingerprints built from them key the
         compile cache on the actual values."""
         if comp is None:
-            comp = ExprCompiler([c.dictionary for c in batch.columns])
+            comp = ExprCompiler([c.dictionary for c in batch.columns],
+                    bounds=[c.bounds for c in batch.columns])
         resolved = [self._resolve_subqueries(e) for e in exprs]
         return resolved, [comp.compile(e) for e in resolved], comp
 
@@ -275,7 +372,7 @@ class Executor:
             return fn
         out = self._jitted("filter", fp, build)(strip_dicts(batch),
                                                 comp.pool.device_args())
-        return attach_dicts(out, [c_.dictionary for c_ in batch.columns])
+        return attach_dicts(out, *col_meta(batch.columns))
 
     def _exec_project(self, plan: L.Project) -> DeviceBatch:
         batch = self._exec(plan.input)
@@ -298,7 +395,8 @@ class Executor:
             return fn
         out = self._jitted("project", fp, build)(strip_dicts(batch),
                                                  comp.pool.device_args())
-        return attach_dicts(out, [cc.out_dict for cc in comps])
+        return attach_dicts(out, [cc.out_dict for cc in comps],
+                    [cc.out_bounds for cc in comps])
 
     # --- blocking ops ---
 
@@ -310,7 +408,8 @@ class Executor:
         return self._aggregate(batch, plan.group_exprs, plan.aggs, plan.schema)
 
     def _aggregate(self, batch, group_exprs, aggs, out_schema) -> DeviceBatch:
-        comp = ExprCompiler([c.dictionary for c in batch.columns])
+        comp = ExprCompiler([c.dictionary for c in batch.columns],
+                    bounds=[c.bounds for c in batch.columns])
         gres, groups, _ = self._compile_exprs(group_exprs, batch, comp)
         specs = []
         ares = []
@@ -471,16 +570,18 @@ class Executor:
         def build():
             return distinct_batch
         out = self._jitted("distinct", fp, build)(strip_dicts(batch))
-        out = attach_dicts(out, [c.dictionary for c in batch.columns])
+        out = attach_dicts(out, *col_meta(batch.columns))
         return self._maybe_shrink(out)
 
     def _exec_join(self, plan: L.Join) -> DeviceBatch:
         left = self._exec(plan.left)
         right = self._exec(plan.right)
         pool = ConstPool()
-        compL = ExprCompiler([c.dictionary for c in left.columns], pool)
+        compL = ExprCompiler([c.dictionary for c in left.columns], pool,
+                     bounds=[c.bounds for c in left.columns])
         lres, lk, _ = self._compile_exprs(plan.left_keys, left, compL)
-        compR = ExprCompiler([c.dictionary for c in right.columns], pool)
+        compR = ExprCompiler([c.dictionary for c in right.columns], pool,
+                     bounds=[c.bounds for c in right.columns])
         rres, rk, _ = self._compile_exprs(plan.right_keys, right, compR)
         jt = plan.join_type
         use_lk, use_rk = ([], []) if jt is JoinType.CROSS else (lk, rk)
@@ -501,6 +602,52 @@ class Executor:
                   plan.join_type, batch_proto_key(left), batch_proto_key(right),
                   pool.signature(), marks)
 
+        if jt in (JoinType.SEMI, JoinType.ANTI):
+            meta_cols = left.columns
+        else:
+            meta_cols = list(left.columns) + list(right.columns)
+        dicts, bnds = col_meta(meta_cols)
+
+        ls, rs = strip_dicts(left), strip_dicts(right)
+        consts = pool.device_args()
+
+        # direct "array join" fast path (exec/join.py): dense-integer PK-FK
+        # joins become one scatter + one gather; a deferred duplicate flag
+        # falls back to the exact sorted-probe path below (via _exact_copy,
+        # which runs with _speculate=False and therefore skips this branch).
+        # The ("nodirect", jfp) negative cache skips joins whose build side
+        # already proved to have duplicate keys.
+        jfp_core = (expr_fingerprint(lres + rres + rres2), jt)
+        jfp = jfp_core + (left.capacity, right.capacity)
+        if self._speculate and use_lk:
+            banned = frozenset(
+                s for s in ("left", "right")
+                if self._cache.get(("nodirect", jfp_core, s)))
+            pick = choose_direct_build(use_lk, use_rk, left.capacity,
+                                       right.capacity, jt, banned=banned)
+            if pick is not None:
+                side, (blo, bhi), ki = pick
+                swapped = side == "left"
+                tsize = bhi - blo + 1
+                pks = use_rk if swapped else use_lk
+                bks = use_lk if swapped else use_rk
+                pkey, bkey = pks[ki], bks[ki]
+                extra = [(pks[i], bks[i]) for i in range(len(pks)) if i != ki]
+                fn = self._jitted(
+                    "join_direct", (fpbase, plan.schema, side, blo, tsize, ki),
+                    lambda: (lambda pb, bb, c: direct_join_phase(
+                        pb, bb, pkey, bkey, blo, tsize, swapped, jt,
+                        residual, plan.schema, c, extra_keys=extra)))
+                tracing.counter("join.direct")
+                out, dup = fn(rs if swapped else ls, ls if swapped else rs,
+                              consts)
+                self._deferred_overflow.append(
+                    (("dup", (jfp_core, side)), dup))
+                # carrying padded lanes beats a count sync (cf. speculative
+                # sorted branch below); the final fetch compacts
+                return attach_dicts(out, dicts[: len(out.columns)],
+                                    bnds[: len(out.columns)])
+
         probe = self._jitted(
             "join_probe", fpbase,
             lambda: (lambda l, r, consts: probe_phase(
@@ -511,25 +658,20 @@ class Executor:
                 l, r, p, match_cap, jt, residual, plan.schema, consts)),
             static_argnums=(3,))
 
-        ls, rs = strip_dicts(left), strip_dicts(right)
-        consts = pool.device_args()
         p = probe(ls, rs, consts)
         spec_cap = round_capacity(max(left.capacity, right.capacity))
         if (self._speculate and jt is not JoinType.CROSS
                 and spec_cap <= self._SPECULATIVE_JOIN_BUDGET):
             total = None
             match_cap = spec_cap
-            self._deferred_overflow.append(p.total > match_cap)
+            self._deferred_overflow.append((("overflow", jfp),
+                                            p.total > match_cap))
         else:
             total = int(p.total)  # the one host sync
             match_cap = choose_match_capacity(total)
         out = expand(ls, rs, p, match_cap, consts)
-        if jt in (JoinType.SEMI, JoinType.ANTI):
-            dicts = [c.dictionary for c in left.columns]
-        else:
-            dicts = [c.dictionary for c in left.columns] + \
-                [c.dictionary for c in right.columns]
-        out = attach_dicts(out, dicts[: len(out.columns)])
+        out = attach_dicts(out, dicts[: len(out.columns)],
+                           bnds[: len(out.columns)])
         if total is None:
             # speculative path: carrying padded lanes beats a count sync
             return out
@@ -556,7 +698,7 @@ class Executor:
             return fn
         out = self._jitted("sort", fp, build)(strip_dicts(batch),
                                               comp.pool.device_args())
-        return attach_dicts(out, [c.dictionary for c in batch.columns])
+        return attach_dicts(out, *col_meta(batch.columns))
 
     def _exec_limit(self, plan: L.Limit) -> DeviceBatch:
         batch = self._exec(plan.input)
@@ -567,7 +709,7 @@ class Executor:
                 return limit_batch(b, plan.limit, plan.offset)
             return fn
         out = self._jitted("limit", fp, build)(strip_dicts(batch))
-        out = attach_dicts(out, [c.dictionary for c in batch.columns])
+        out = attach_dicts(out, *col_meta(batch.columns))
         # LIMIT bounds the live count statically — no sync needed
         known = plan.limit if plan.limit is not None else None
         return self._maybe_shrink(out, known_live=known)
@@ -593,7 +735,7 @@ class Executor:
         def build():
             return distinct_batch
         out = self._jitted("distinct", fp, build)(strip_dicts(batch))
-        return attach_dicts(out, [c.dictionary for c in batch.columns])
+        return attach_dicts(out, *col_meta(batch.columns))
 
     def _col_ref(self, batch: DeviceBatch, i: int) -> Compiled:
         f = batch.schema.fields[i]
@@ -605,11 +747,19 @@ class Executor:
     def _resolve_subqueries(self, e: E.Expr) -> E.Expr:
         def sub(n):
             if isinstance(n, E.ScalarSubquery):
+                # memoized on the node: plans are rebuilt per engine.execute,
+                # so this caches only within one execution — in particular a
+                # fused attempt falling back to the staged path (or a repair
+                # re-run) does not re-execute the subquery
+                memo = getattr(n, "_resolved_lit", None)
+                if memo is not None:
+                    return memo
                 if not isinstance(n.query, L.LogicalPlan):
                     raise PlanError("unbound scalar subquery reached executor")
                 val, dtype = self._eval_scalar(n.query)
                 lit = E.Literal(value=val, literal_type=dtype)
                 lit.dtype = n.dtype or dtype
+                n._resolved_lit = lit
                 return lit
             return n
         return E.transform(e, sub)
@@ -654,11 +804,10 @@ class Executor:
 
             def build():
                 def fn(b):
-                    return K.resize_batch(
-                        K.apply_perm(b, K.compact_perm(b.live)), want)
+                    return K.compact_to(b, want)
                 return fn
             out = self._jitted("compact", fp, build)(strip_dicts(batch))
-            return attach_dicts(out, [c.dictionary for c in batch.columns])
+            return attach_dicts(out, *col_meta(batch.columns))
         return batch
 
 

@@ -130,6 +130,9 @@ class Compiled:
     fn: Callable[[Env], tuple]  # Env -> (vals, nulls|None)
     dtype: T.DataType
     out_dict: Optional[DictInfo] = None  # set iff dtype is STRING
+    # (lo, hi) host-known value bounds for integer-family outputs (bare column
+    # refs / int literals); feeds the direct-join strategy choice. None = unknown.
+    out_bounds: Optional[tuple] = None
 
 
 class ExprCompileError(Exception):
@@ -237,14 +240,17 @@ class ExprCompiler:
     executor's compile-cache key — so a cached executable is only reused when
     the new compile would have traced the identical program."""
 
-    def __init__(self, dicts: list, pool: Optional[ConstPool] = None):
+    def __init__(self, dicts: list, pool: Optional[ConstPool] = None,
+                 bounds: Optional[list] = None):
         self.dicts = dicts  # per input-column Optional[DictInfo]
+        self.bounds = bounds  # per input-column Optional[(lo, hi)]; None = all unknown
         self.pool = pool if pool is not None else ConstPool()
         self.marks: list = []
 
     @staticmethod
     def for_batch(batch: DeviceBatch, pool: Optional[ConstPool] = None) -> "ExprCompiler":
-        return ExprCompiler([c.dictionary for c in batch.columns], pool)
+        return ExprCompiler([c.dictionary for c in batch.columns], pool,
+                            bounds=[c.bounds for c in batch.columns])
 
     def compile(self, e: E.Expr) -> Compiled:
         m = getattr(self, "_c_" + type(e).__name__.lower(), None)
@@ -259,7 +265,9 @@ class ExprCompiler:
         if idx is None:
             raise ExprCompileError(f"unbound column {e.name}")
         d = self.dicts[idx] if idx < len(self.dicts) else None
-        return Compiled(lambda env: (env.values[idx], env.nulls[idx]), e.dtype, d)
+        b = self.bounds[idx] if self.bounds and idx < len(self.bounds) else None
+        return Compiled(lambda env: (env.values[idx], env.nulls[idx]), e.dtype,
+                        d, out_bounds=b)
 
     def _c_literal(self, e: E.Literal) -> Compiled:
         dt = e.dtype or e.literal_type

@@ -1,0 +1,523 @@
+"""Whole-plan fusion: compile an entire logical plan into ONE jitted function.
+
+The staged executor (exec/executor.py) dispatches one jit per plan node. On a
+tunneled TPU every dispatch costs a host<->device round trip (~100-300 ms
+measured), so an 11-stage TPC-H Q3 pays ~3 s of pure RTT while the device work
+is tens of milliseconds. This module realizes SURVEY.md §7's design stance —
+"each fragment lowers to ONE `jax.jit` computation" — end to end: the whole
+query becomes a single XLA program: one dispatch, one small fetch.
+
+The reference has no analog: its operators stream record batches through async
+channels per node (crates/engine/src/physical_plan.rs:28-47), an architecture
+that would serialize on the TPU's dispatch latency exactly like the staged path.
+
+**Adaptive capacity hints.** Static shapes mean intermediate batches are padded
+to their worst case (a filtered 6M-row lineitem keeps 8M lanes); carrying full
+width through joins/aggregates/sorts costs ~0.1-1 s per 8M-lane gather/scatter.
+Observed live counts from each run are recorded as per-node cardinality hints
+(standard adaptive query execution, keyed by the node's structural fingerprint
+— data changes change scan fingerprints and so invalidate hints naturally).
+On later runs the program compacts intermediates down to the hinted power-of-two
+capacity INSIDE the fused program; a deferred `n > capacity` flag triggers one
+repair re-run with corrected hints, so results are always exact. Direct inner
+joins go further: with a hint, build-side columns are gathered only AFTER the
+probe-side compaction, at hinted width (lazy materialization).
+
+Correctness flags collected across the program (direct-join duplicate keys,
+speculative join capacity overflow, compaction overflow) come back in the same
+single fetch; only a raised flag or an oversized result costs extra round trips.
+
+Raises FusionUnsupported for shapes that need host decisions (non-speculative
+joins past the capacity budget, distinct aggregates, set ops, unions); the
+caller falls back to the staged executor.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from igloo_tpu import types as T
+from igloo_tpu.exec import kernels as K
+from igloo_tpu.exec.aggregate import (
+    AggSpec, aggregate_batch, distinct_batch, minmax_order_arg, seg_dims_for,
+)
+from igloo_tpu.exec.batch import (
+    MIN_CAPACITY, DeviceBatch, DeviceColumn, round_capacity,
+)
+from igloo_tpu.exec.expr_compile import (
+    ConstPool, Env, ExprCompiler, rank_lane,
+)
+from igloo_tpu.exec.join import (
+    choose_direct_build, direct_join_phase, direct_probe, expand_phase,
+    make_key_hash_idxs, probe_phase,
+)
+from igloo_tpu.exec.sort_limit import limit_batch, sort_batch
+from igloo_tpu.plan import logical as L
+from igloo_tpu.sql.ast import JoinType
+
+
+class FusionUnsupported(Exception):
+    """This plan needs host-side decisions between stages; use the staged path."""
+
+
+@dataclass
+class NodeMeta:
+    """Host-side metadata mirror of a node's output batch: what expression
+    compilation and join planning need, computed without running the device."""
+    schema: T.Schema
+    dicts: list
+    bounds: list
+    capacity: int
+
+
+@dataclass
+class Ctx:
+    """Trace-time side channels: flag/stat ids are assigned at compile time,
+    values filled during tracing (dict keys are static pytree aux, so the
+    ordering of appends never matters)."""
+    flags: dict = field(default_factory=dict)  # id -> device bool
+    stats: dict = field(default_factory=dict)  # id -> device int64 live count
+
+
+# NodeFn: (leaves, consts, ctx) -> DeviceBatch (jit-traceable)
+NodeFn = Callable
+
+# node outputs wider than this become adaptive-compaction candidates
+ADAPTIVE_CAPACITY = 1 << 18
+# only compact when the hinted capacity shrinks the batch at least this much
+ADAPTIVE_SHRINK = 4
+
+
+class FusedCompiler:
+    """One-shot compiler: plan -> (run, leaves, pool, cache_key, out_meta)."""
+
+    # results at or under this capacity come back in the single fetch
+    FETCH_CAPACITY = 1 << 12
+
+    def __init__(self, executor):
+        self.ex = executor
+        self.pool = ConstPool()
+        self.leaves: list[DeviceBatch] = []
+        self.marks: list = []
+        self.fps: list = []
+        # hint-INDEPENDENT fingerprints: same node entries as fps but without
+        # adopted-hint artifacts (acompact markers, lazy-join want sizes).
+        # Hint keys derive from these, so adopting one node's hint never
+        # changes another node's key — all hints adopt in ONE re-run instead
+        # of cascading one plan level per run.
+        self.hfps: list = []
+        self.flag_tags: list = []   # flag id -> ("dup"|"overflow"|"compact", key)
+        self.stat_keys: list = []   # stat id -> nhint cache key
+
+    # --- side-channel ids -------------------------------------------------
+
+    def _push(self, fp, hint_fp="same") -> None:
+        """Append a node fingerprint; hint_fp=None skips the hint list,
+        any other value replaces the entry there."""
+        self.fps.append(fp)
+        if hint_fp == "same":
+            self.hfps.append(fp)
+        elif hint_fp is not None:
+            self.hfps.append(hint_fp)
+
+    def _new_flag(self, tag) -> int:
+        self.flag_tags.append(tag)
+        return len(self.flag_tags) - 1
+
+    def _new_stat(self, key) -> int:
+        self.stat_keys.append(key)
+        return len(self.stat_keys) - 1
+
+    def _hint(self, key) -> Optional[int]:
+        v = self.ex._cache.get(("nhint", key))
+        if v is None and self.ex._hints is not None:
+            v = self.ex._hints.get(key)  # persistent store (fresh process)
+            if v is not None:
+                self.ex._cache[("nhint", key)] = v
+        return int(v) if v is not None else None
+
+    # --- public -----------------------------------------------------------
+
+    def compile(self, plan: L.LogicalPlan):
+        fn, meta = self._c(plan)
+        fetch_cap = self.FETCH_CAPACITY
+
+        def run(leaves, consts):
+            ctx = Ctx()
+            out = fn(leaves, consts, ctx)
+            n = jnp.sum(out.live.astype(jnp.int64))
+            if out.capacity > fetch_cap:
+                spec = K.compact_to(out, fetch_cap)
+            else:
+                spec = out
+            return out, spec, n, ctx.flags, ctx.stats
+
+        key = ("fused", tuple(self.fps), self.pool.signature(),
+               tuple(self.marks), fetch_cap)
+        return run, key, meta
+
+    # --- dispatch ---------------------------------------------------------
+
+    _ADAPTIVE_NODES = ("filter", "join", "aggregate", "distinct")
+
+    def _c(self, plan: L.LogicalPlan):
+        name = type(plan).__name__.lower()
+        m = getattr(self, "_c_" + name, None)
+        if m is None:
+            raise FusionUnsupported(type(plan).__name__)
+        fn, meta = m(plan)
+        if meta.schema is not plan.schema and meta.schema != plan.schema:
+            meta = NodeMeta(plan.schema, meta.dicts, meta.bounds, meta.capacity)
+
+            def renamed(leaves, consts, ctx, _fn=fn, _s=plan.schema):
+                b = _fn(leaves, consts, ctx)
+                return DeviceBatch(_s, b.columns, b.live)
+            fn = renamed
+        if name in self._ADAPTIVE_NODES and meta.capacity > ADAPTIVE_CAPACITY:
+            fn, meta = self._adaptive(fn, meta, name)
+        return fn, meta
+
+    def _adaptive(self, fn: NodeFn, meta: NodeMeta, kind: str):
+        """Record this node's live count as a cardinality hint; when a prior
+        run's hint shows a strong shrink, compact to the hinted capacity inside
+        the program, flagging overflow (exact repair re-run with fresh hints)."""
+        hkey = (kind, tuple(self.hfps))
+        sid = self._new_stat(hkey)
+        hint = self._hint(hkey)
+        want = round_capacity(max(hint, 1)) if hint is not None else None
+        if want is not None and want * ADAPTIVE_SHRINK <= meta.capacity:
+            fid = self._new_flag(("compact", hkey))
+            self._push(("acompact", want), hint_fp=None)
+
+            def cfn(leaves, consts, ctx):
+                out = fn(leaves, consts, ctx)
+                n = jnp.sum(out.live.astype(jnp.int64))
+                ctx.stats[sid] = n
+                ctx.flags[fid] = n > want
+                return K.compact_to(out, want)
+            return cfn, NodeMeta(meta.schema, meta.dicts, meta.bounds, want)
+
+        def sfn(leaves, consts, ctx):
+            out = fn(leaves, consts, ctx)
+            ctx.stats[sid] = jnp.sum(out.live.astype(jnp.int64))
+            return out
+        return sfn, meta
+
+    def _compiler_for(self, meta: NodeMeta) -> ExprCompiler:
+        return ExprCompiler(meta.dicts, self.pool, bounds=meta.bounds)
+
+    def _compile_exprs(self, exprs, comp: ExprCompiler):
+        """Resolve scalar subqueries (recursively executing them NOW, host
+        side), then compile. Returns (resolved, compiled)."""
+        resolved = [self.ex._resolve_subqueries(e) for e in exprs]
+        out = [comp.compile(e) for e in resolved]
+        return resolved, out
+
+    # --- leaves -----------------------------------------------------------
+
+    def _c_scan(self, plan: L.Scan):
+        batch = self.ex._exec_scan(plan)
+        idx = len(self.leaves)
+        self.leaves.append(batch)
+        meta = NodeMeta(plan.schema, [c.dictionary for c in batch.columns],
+                        [c.bounds for c in batch.columns], batch.capacity)
+        # NOTE: deliberately content-light — dictionary content feeds compiled
+        # code through ConstPool args (pool.signature() keys sizes); bounds DO
+        # join the key because they become direct-join program constants
+        self._push(("scan", plan.table, tuple(plan.projection or ()),
+                    repr(plan.pushed_filters), plan.partition,
+                    plan.schema, batch.capacity,
+                    tuple(c.nulls is not None for c in batch.columns),
+                    tuple(meta.bounds)))
+
+        def fn(leaves, consts, ctx, _i=idx):
+            return leaves[_i]
+        return fn, meta
+
+    # --- row-wise ---------------------------------------------------------
+
+    def _c_filter(self, plan: L.Filter):
+        cfn, meta = self._c(plan.input)
+        comp = self._compiler_for(meta)
+        res, [c] = self._compile_exprs([plan.predicate], comp)
+        self.marks.extend(comp.marks)
+        self._push(("filter", repr(res[0])))
+
+        def fn(leaves, consts, ctx):
+            b = cfn(leaves, consts, ctx)
+            env = Env.from_batch(b, consts)
+            v, nl = c.fn(env)
+            keep = b.live & v
+            if nl is not None:
+                keep = keep & ~nl
+            return DeviceBatch(b.schema, b.columns, keep)
+        return fn, meta
+
+    def _c_project(self, plan: L.Project):
+        cfn, meta = self._c(plan.input)
+        comp = self._compiler_for(meta)
+        res, comps = self._compile_exprs(plan.exprs, comp)
+        self.marks.extend(comp.marks)
+        self._push(("project", tuple(repr(e) for e in res), plan.schema))
+        out_schema = plan.schema
+
+        def fn(leaves, consts, ctx):
+            b = cfn(leaves, consts, ctx)
+            env = Env.from_batch(b, consts)
+            cols = []
+            for cc, f in zip(comps, out_schema.fields):
+                v, nl = cc.fn(env)
+                want = f.dtype.device_dtype()
+                if v.dtype != want:
+                    v = v.astype(want)
+                cols.append(DeviceColumn(f.dtype, v, nl, None))
+            return DeviceBatch(out_schema, cols, b.live)
+        out_meta = NodeMeta(out_schema, [cc.out_dict for cc in comps],
+                            [cc.out_bounds for cc in comps], meta.capacity)
+        return fn, out_meta
+
+    # --- joins ------------------------------------------------------------
+
+    def _c_join(self, plan: L.Join):
+        lfn, lmeta = self._c(plan.left)
+        rfn, rmeta = self._c(plan.right)
+        jt = plan.join_type
+        compL = self._compiler_for(lmeta)
+        lres, lk = self._compile_exprs(plan.left_keys, compL)
+        compR = self._compiler_for(rmeta)
+        rres, rk = self._compile_exprs(plan.right_keys, compR)
+        self.marks.extend(compL.marks)
+        self.marks.extend(compR.marks)
+        use_lk, use_rk = ([], []) if jt is JoinType.CROSS else (lk, rk)
+        residual = None
+        rres2 = []
+        if plan.residual is not None:
+            compB = ExprCompiler(lmeta.dicts + rmeta.dicts, self.pool,
+                                 bounds=lmeta.bounds + rmeta.bounds)
+            r = self.ex._resolve_subqueries(plan.residual)
+            rres2 = [r]
+            residual = compB.compile(r)
+            self.marks.extend(compB.marks)
+
+        if jt in (JoinType.SEMI, JoinType.ANTI):
+            out_dicts = list(lmeta.dicts)
+            out_bounds = list(lmeta.bounds)
+        else:
+            out_dicts = list(lmeta.dicts) + list(rmeta.dicts)
+            out_bounds = list(lmeta.bounds) + list(rmeta.bounds)
+        out_dicts = out_dicts[: len(plan.schema)]
+        out_bounds = out_bounds[: len(plan.schema)]
+
+        # jfp_core is capacity-free: hint keys derive from it so that child
+        # hint adoption (which shrinks child capacities) never changes this
+        # join's hint key. The full jfp (with caps) keys programs/negatives.
+        jfp_core = ("join", tuple(repr(e) for e in lres),
+                    tuple(repr(e) for e in rres),
+                    tuple(repr(e) for e in rres2), jt)
+        jfp = jfp_core + (lmeta.capacity, rmeta.capacity)
+
+        pick = None
+        if use_lk:
+            banned = frozenset(
+                s for s in ("left", "right")
+                if self.ex._cache.get(("nodirect", jfp_core, s)))
+            pick = choose_direct_build(use_lk, use_rk, lmeta.capacity,
+                                       rmeta.capacity, jt, banned=banned)
+        if pick is not None:
+            return self._c_join_direct(plan, jfp, jfp_core, pick, lfn, lmeta,
+                                       rfn, rmeta, use_lk, use_rk, residual,
+                                       out_dicts, out_bounds)
+
+        # speculative sorted-probe join: static match capacity, deferred
+        # overflow flag. Past the budget a host sync would be required.
+        spec_cap = round_capacity(max(lmeta.capacity, rmeta.capacity))
+        if jt is JoinType.CROSS or spec_cap > self.ex._SPECULATIVE_JOIN_BUDGET:
+            raise FusionUnsupported("join needs a host capacity sync")
+        lhx = make_key_hash_idxs(use_lk, self.pool)
+        rhx = make_key_hash_idxs(use_rk, self.pool)
+        if jt in (JoinType.SEMI, JoinType.ANTI):
+            out_cap = lmeta.capacity
+        else:
+            out_cap = spec_cap
+            if jt in (JoinType.LEFT, JoinType.FULL):
+                out_cap += lmeta.capacity
+            if jt in (JoinType.RIGHT, JoinType.FULL):
+                out_cap += rmeta.capacity
+        self._push(("join_sorted",) + jfp[1:] + (spec_cap, plan.schema),
+                   hint_fp=("join_sorted",) + jfp_core[1:] + (plan.schema,))
+        fid = self._new_flag(("overflow", jfp))
+
+        def fn(leaves, consts, ctx):
+            lb = lfn(leaves, consts, ctx)
+            rb = rfn(leaves, consts, ctx)
+            p = probe_phase(lb, rb, use_lk, use_rk, lhx, rhx, consts)
+            ctx.flags[fid] = p.total > spec_cap
+            return expand_phase(lb, rb, p, spec_cap, jt, residual,
+                                plan.schema, consts)
+        return fn, NodeMeta(plan.schema, out_dicts, out_bounds, out_cap)
+
+    def _c_join_direct(self, plan, jfp, jfp_core, pick, lfn, lmeta, rfn,
+                       rmeta, use_lk, use_rk, residual, out_dicts, out_bounds):
+        jt = plan.join_type
+        side, (blo, bhi), ki = pick
+        swapped = side == "left"
+        tsize = bhi - blo + 1
+        pks = use_rk if swapped else use_lk
+        bks = use_lk if swapped else use_rk
+        pkey, bkey = pks[ki], bks[ki]
+        extra = [(pks[i], bks[i]) for i in range(len(pks)) if i != ki]
+        probe_cap = rmeta.capacity if swapped else lmeta.capacity
+        probe_is_left = not swapped
+        fid = self._new_flag(("dup", (jfp_core, side)))
+
+        # lazy inner join under a cardinality hint: run the probe at full
+        # width, compact (probe cols + match index) down to the hinted
+        # capacity, and only then gather build-side columns — narrow-width
+        # materialization instead of N full-width gathers
+        hkey = ("joinout", jfp_core, tuple(self.hfps))
+        hint = self._hint(hkey) if jt is JoinType.INNER else None
+        want = round_capacity(max(hint, 1)) if hint is not None else None
+        if want is not None and want * ADAPTIVE_SHRINK <= probe_cap:
+            sid = self._new_stat(hkey)
+            ofid = self._new_flag(("compact", hkey))
+            self._push(("join_lazy",) + jfp[1:] +
+                       (side, blo, tsize, ki, want, plan.schema),
+                       hint_fp=("join_direct",) + jfp_core[1:] +
+                       (plan.schema,))
+
+            def fn(leaves, consts, ctx):
+                lb = lfn(leaves, consts, ctx)
+                rb = rfn(leaves, consts, ctx)
+                pb, bb = (rb, lb) if swapped else (lb, rb)
+                ok, bidx, dup = direct_probe(pb, bb, pkey, bkey, blo,
+                                             tsize, swapped, residual,
+                                             consts, extra)
+                ctx.flags[fid] = dup
+                n = jnp.sum(ok.astype(jnp.int64))
+                ctx.stats[sid] = n
+                ctx.flags[ofid] = n > want
+                perm = K.compact_perm(ok)[:want]
+                live = jnp.take(ok, perm)
+                p_cols = [DeviceColumn(c.dtype, jnp.take(c.values, perm),
+                                       jnp.take(c.nulls, perm)
+                                       if c.nulls is not None else None,
+                                       None) for c in pb.columns]
+                nbidx = jnp.clip(jnp.take(bidx, perm), 0, bb.capacity - 1)
+                b_cols = K.gather_batch(bb, nbidx)
+                l_cols, r_cols = (b_cols, p_cols) if swapped \
+                    else (p_cols, b_cols)
+                return DeviceBatch(plan.schema, l_cols + r_cols, live)
+            return fn, NodeMeta(plan.schema, out_dicts, out_bounds, want)
+
+        if jt is JoinType.INNER:
+            sid = self._new_stat(hkey)
+        else:
+            sid = None
+        if jt in (JoinType.SEMI, JoinType.ANTI):
+            out_cap = lmeta.capacity
+        else:
+            build_cap = lmeta.capacity if swapped else rmeta.capacity
+            build_preserved = (
+                jt is JoinType.FULL
+                or (jt is JoinType.LEFT and not probe_is_left)
+                or (jt is JoinType.RIGHT and probe_is_left))
+            out_cap = probe_cap + (build_cap if build_preserved else 0)
+        self._push(("join_direct",) + jfp[1:] +
+                   (side, blo, tsize, ki, plan.schema),
+                   hint_fp=("join_direct",) + jfp_core[1:] + (plan.schema,))
+
+        def fn(leaves, consts, ctx):
+            lb = lfn(leaves, consts, ctx)
+            rb = rfn(leaves, consts, ctx)
+            pb, bb = (rb, lb) if swapped else (lb, rb)
+            out, dup = direct_join_phase(pb, bb, pkey, bkey, blo, tsize,
+                                         swapped, jt, residual,
+                                         plan.schema, consts,
+                                         extra_keys=extra)
+            ctx.flags[fid] = dup
+            if sid is not None:
+                ctx.stats[sid] = jnp.sum(out.live.astype(jnp.int64))
+            return out
+        return fn, NodeMeta(plan.schema, out_dicts, out_bounds, out_cap)
+
+    # --- aggregates -------------------------------------------------------
+
+    def _c_aggregate(self, plan: L.Aggregate):
+        if any(a.distinct for a in plan.aggs):
+            raise FusionUnsupported("distinct aggregate")
+        cfn, meta = self._c(plan.input)
+        comp = self._compiler_for(meta)
+        gres, groups = self._compile_exprs(plan.group_exprs, comp)
+        specs = []
+        ares = []
+        for a in plan.aggs:
+            if a.arg is not None:
+                [r], [arg] = self._compile_exprs([a.arg], comp)
+                ares.append(r)
+            else:
+                arg = None
+            out_dict = arg.out_dict if (arg is not None and a.dtype.is_string) \
+                else None
+            specs.append(AggSpec(a.func, arg, a.dtype, out_dict,
+                                 order_arg=minmax_order_arg(a.func, arg, comp)))
+        self.marks.extend(comp.marks)
+        seg_dims = seg_dims_for(groups)
+        self._push(("agg", tuple(repr(e) for e in gres + ares),
+                    tuple((a.func, a.dtype) for a in plan.aggs),
+                    plan.schema, seg_dims))
+        out_schema = plan.schema
+
+        def fn(leaves, consts, ctx):
+            b = cfn(leaves, consts, ctx)
+            return aggregate_batch(b, groups, specs, out_schema, consts,
+                                   seg_dims=seg_dims)
+        if not groups:
+            cap = MIN_CAPACITY
+        elif seg_dims is not None:
+            prod = 1
+            for d in seg_dims:
+                prod *= d
+            cap = round_capacity(prod + 1)
+        else:
+            cap = meta.capacity
+        out_meta = NodeMeta(out_schema,
+                            [g.out_dict for g in groups] +
+                            [s.out_dict for s in specs],
+                            [g.out_bounds for g in groups] +
+                            [None] * len(specs), cap)
+        return fn, out_meta
+
+    def _c_distinct(self, plan: L.Distinct):
+        cfn, meta = self._c(plan.input)
+        self._push(("distinct",))
+
+        def fn(leaves, consts, ctx):
+            return distinct_batch(cfn(leaves, consts, ctx))
+        return fn, meta
+
+    # --- ordering ---------------------------------------------------------
+
+    def _c_sort(self, plan: L.Sort):
+        cfn, meta = self._c(plan.input)
+        comp = self._compiler_for(meta)
+        res, keys = self._compile_exprs(plan.keys, comp)
+        keys = [rank_lane(k, comp) if k.dtype.is_string else k for k in keys]
+        self.marks.extend(comp.marks)
+        self._push(("sort", tuple(repr(e) for e in res),
+                    tuple(plan.ascending), tuple(plan.nulls_first)))
+        asc, nf = list(plan.ascending), list(plan.nulls_first)
+
+        def fn(leaves, consts, ctx):
+            return sort_batch(cfn(leaves, consts, ctx), keys, asc, nf, consts)
+        return fn, meta
+
+    def _c_limit(self, plan: L.Limit):
+        cfn, meta = self._c(plan.input)
+        self._push(("limit", plan.limit, plan.offset))
+
+        def fn(leaves, consts, ctx):
+            return limit_batch(cfn(leaves, consts, ctx), plan.limit,
+                               plan.offset)
+        return fn, meta

@@ -1,0 +1,70 @@
+"""Persistent cardinality-hint store for adaptive fused execution.
+
+The fused compiler (exec/fused.py) sizes intermediate compactions from
+observed live counts. In-memory hints die with the process, which would make
+every fresh process pay the un-hinted full-width program AND a second XLA
+compile once hints arrive. Persisting them beside the XLA compilation cache
+means a new process compiles the hinted program directly — and hits the
+persistent XLA cache for it.
+
+Keys are structural node fingerprints (nested tuples); they are stored under a
+stable content hash of their repr. A hash collision or stale entry can only
+mis-SIZE a compaction, never corrupt a result: the in-program overflow flag
+triggers an exact repair re-run (see FusedCompiler._adaptive)."""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Optional
+
+
+def _digest(key) -> str:
+    return hashlib.sha1(repr(key).encode()).hexdigest()
+
+
+class HintStore:
+    def __init__(self, path: Optional[str]):
+        self._path = path
+        self._data: dict[str, int] = {}
+        self._dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._data = {k: int(v) for k, v in json.load(f).items()}
+            except Exception:
+                self._data = {}
+
+    def get(self, key) -> Optional[int]:
+        return self._data.get(_digest(key))
+
+    def put(self, key, n: int) -> None:
+        d = _digest(key)
+        if self._data.get(d) != n:
+            self._data[d] = int(n)
+            self._dirty = True
+
+    def flush(self) -> None:
+        if not self._dirty or not self._path:
+            return
+        self._dirty = False
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self._path))
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._data, f)
+            os.replace(tmp, self._path)
+        except Exception:
+            pass  # hints are an optimization; never fail a query over them
+
+
+def default_store() -> HintStore:
+    """Store beside the persistent XLA cache (same enable/disable knob)."""
+    import jax
+    try:
+        cache_dir = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        cache_dir = None
+    return HintStore(os.path.join(cache_dir, "nhints.json")
+                     if cache_dir else None)

@@ -235,6 +235,28 @@ def gather_batch(batch: DeviceBatch, idx: jax.Array,
     return cols
 
 
+def compact_to(batch: DeviceBatch, capacity: int) -> DeviceBatch:
+    """Compact live rows to the front AND resize to `capacity` in one step,
+    slicing the permutation BEFORE the column gathers so every gather is
+    output-sized. The equivalent apply_perm(compact_perm)+resize pair gathers
+    every column at FULL input width first — at 8M lanes x 8 columns that is
+    ~0.5s of wasted HBM traffic per compaction on a v5e (XLA does not sink the
+    later slice into the gather operand). Rows past `capacity` are dropped;
+    callers guarantee (or flag-check) that live count fits."""
+    perm = compact_perm(batch.live)
+    if capacity < perm.shape[0]:
+        perm = perm[:capacity]
+    cols = []
+    for c in batch.columns:
+        vals = jnp.take(c.values, perm)
+        nulls = jnp.take(c.nulls, perm) if c.nulls is not None else None
+        cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
+    live = jnp.take(batch.live, perm)
+    if capacity > perm.shape[0]:
+        return resize_batch(DeviceBatch(batch.schema, cols, live), capacity)
+    return DeviceBatch(batch.schema, cols, live)
+
+
 def resize_to(values: jax.Array, capacity: int, fill=0) -> jax.Array:
     n = values.shape[0]
     if n == capacity:

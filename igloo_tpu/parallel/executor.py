@@ -71,6 +71,8 @@ _ASSOCIATIVE = {E.AggFunc.SUM: E.AggFunc.SUM, E.AggFunc.MIN: E.AggFunc.MIN,
 class ShardedExecutor(Executor):
     """Executor whose blocking operators run as mesh programs (see module doc)."""
 
+    _FUSE = False  # stages shard_map over the mesh; single-program fusion n/a
+
     def __init__(self, jit_cache: Optional[dict] = None, use_jit: bool = True,
                  batch_cache=None, speculate: bool = True,
                  mesh: Optional[Mesh] = None):
@@ -336,7 +338,7 @@ class ShardedExecutor(Executor):
         out, overflow = self._jitted_shard_map(
             "shagg", fp, local_fn, out_specs=(P(ROWS), P()))(
             strip_dicts(batch), comp.pool.device_args())
-        self._deferred_overflow.append(overflow)
+        self._deferred_overflow.append((("overflow", None), overflow))
         out = attach_dicts(out, [g.out_dict for g in groups] +
                            self._agg_out_dicts(aggs, compiled_args))
         return out
@@ -474,7 +476,7 @@ class ShardedExecutor(Executor):
             lambda l, r, c: local_fn(l, r, c),
             out_specs=(P(ROWS), P()), n_batch_args=2)(
             strip_dicts(left), strip_dicts(right), consts)
-        self._deferred_overflow.append(overflow)
+        self._deferred_overflow.append((("overflow", None), overflow))
         if jt in (JoinType.SEMI, JoinType.ANTI):
             dicts = [c.dictionary for c in left.columns]
         else:

@@ -45,6 +45,10 @@ class Scan(LogicalPlan):
     # restrict the scan to these provider partition indices (distributed /
     # chunked execution); None = whole table
     partition: Optional[tuple[int, ...]] = None
+    # fingerprint of the provider's partition index captured at planning time;
+    # verified before partitioned reads (a re-globbed index of the same length
+    # must not silently remap partition ids)
+    partition_token: Optional[str] = None
 
     def node_name(self):
         cols = f" cols={self.projection}" if self.projection is not None else ""

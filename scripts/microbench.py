@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Microbenchmark TPU primitive costs guiding the join kernel design."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 8 << 20   # 8M probe
+M = 2 << 20   # 2M build
+R = 2 << 20   # dense key range
+
+
+def bench(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / 3
+    print(f"{name}: {dt*1000:.1f}ms", file=sys.stderr)
+
+
+rng = np.random.default_rng(0)
+k64 = jnp.asarray(rng.integers(0, 1 << 62, N), dtype=jnp.int64)
+k32 = jnp.asarray(rng.integers(0, 1 << 31, N), dtype=jnp.int32)
+kd = jnp.asarray(rng.integers(0, R, N), dtype=jnp.int32)
+bk = jnp.asarray(rng.permutation(M).astype(np.int32))
+
+bench("argsort int64 8M", lambda x: jnp.argsort(x, stable=True), k64)
+bench("argsort int32 8M", lambda x: jnp.argsort(x, stable=True), k32)
+bench("sort int64 8M", lambda x: jnp.sort(x), k64)
+bench("sort int32 8M", lambda x: jnp.sort(x), k32)
+bench("cumsum int64 8M", lambda x: jnp.cumsum(x), k64)
+bench("cumsum int32 8M", lambda x: jnp.cumsum(x), k32)
+bench("take 8M from 2M", lambda t, i: jnp.take(t, i % M), bk, kd)
+bench("scatter-set 2M into 2M", lambda i: jnp.zeros((R,), jnp.int32).at[i % R].set(jnp.arange(M, dtype=jnp.int32), mode="drop"), bk)
+bench("scatter-add 8M into 2M", lambda i: jnp.zeros((R,), jnp.int32).at[i].add(1, mode="drop"), kd)
+bench("scatter-max 8M into 2M", lambda i: jnp.zeros((R,), jnp.int32).at[i].max(jnp.broadcast_to(jnp.int32(1), (N,)), mode="drop"), kd)
+bench("assoc_scan max 8M", lambda x: jax.lax.associative_scan(jnp.maximum, x), k32)
+# the two-argsort bounds (current join path) vs proposed: 1 combined argsort
+comb64 = jnp.concatenate([k64[:M], k64])
+bench("argsort int64 10M (bounds pass)", lambda x: jnp.argsort(x, stable=True), comb64)

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Microbench the direct-join + compact pieces at Q3 join2 shapes."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 8 << 20      # probe cap (lineitem)
+B = 1 << 18      # build cap (join1 out)
+TS = 1500000     # table size (orderkey range)
+OUT = 1 << 15    # compacted output
+
+
+def bench(name, fn, *args):
+    f = jax.jit(fn)
+    jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = f(*args)
+    jax.block_until_ready(out)
+    print(f"{name}: {(time.perf_counter()-t0)/3*1000:.1f}ms", file=sys.stderr)
+
+
+rng = np.random.default_rng(0)
+bkey = jnp.asarray(rng.choice(TS, B, replace=False).astype(np.int64))
+blive = jnp.asarray(rng.random(B) < 0.55)
+pkey = jnp.asarray(rng.integers(0, TS, P))
+plive = jnp.asarray(rng.random(P) < 0.27)
+bcols = [jnp.asarray(rng.integers(0, 1 << 30, B)) for _ in range(5)]
+pcols = [jnp.asarray(rng.integers(0, 1 << 30, P)) for _ in range(3)]
+
+
+def build_table(bkey, blive):
+    slot = jnp.where(blive, bkey, TS).astype(jnp.int32)
+    table = jnp.full((TS,), -1, jnp.int32).at[slot].max(
+        jnp.arange(B, dtype=jnp.int32), mode="drop")
+    dup = jnp.sum((table >= 0).astype(jnp.int64)) < jnp.sum(blive.astype(jnp.int64))
+    return table, dup
+
+
+bench("build table (scatter 262k -> 1.5M)", build_table, bkey, blive)
+
+
+def probe_gather(table, pkey, plive, *cols):
+    bidx = jnp.take(table, jnp.clip(pkey, 0, TS - 1).astype(jnp.int32))
+    ok = plive & (bidx >= 0)
+    safe = jnp.clip(bidx, 0, B - 1)
+    outs = [jnp.take(c, safe) for c in cols]
+    return ok, outs
+
+
+table, _ = jax.jit(build_table)(bkey, blive)
+bench("probe gather 8M + 5 build cols", probe_gather, table, pkey, plive, *bcols)
+
+
+def full_join(bkey, blive, pkey, plive, bcols, pcols):
+    table, dup = build_table(bkey, blive)
+    bidx = jnp.take(table, jnp.clip(pkey, 0, TS - 1).astype(jnp.int32))
+    ok = plive & (bidx >= 0)
+    safe = jnp.clip(bidx, 0, B - 1)
+    outs = [jnp.take(c, safe) for c in bcols] + list(pcols)
+    return ok, outs, dup
+
+
+bench("full direct join", full_join, bkey, blive, pkey, plive, bcols, pcols)
+
+ok, outs, _ = jax.jit(full_join)(bkey, blive, pkey, plive, bcols, pcols)
+
+
+def compact(ok, outs):
+    perm = jnp.argsort(~ok, stable=True)[:None]
+    live = jnp.take(ok, perm)[:OUT]
+    cols = [jnp.take(c, perm)[:OUT] for c in outs]
+    return live, cols
+
+
+bench("compact 8M -> 32k (argsort bool + 8 gathers)", compact, ok, outs)
+
+
+def compact2(ok, outs):
+    # cumsum-based: target position per live row, scatter into OUT
+    pos = jnp.cumsum(ok.astype(jnp.int32)) - 1
+    tgt = jnp.where(ok, pos, OUT).astype(jnp.int32)
+    live = jnp.zeros((OUT,), bool).at[tgt].set(True, mode="drop")
+    cols = [jnp.zeros((OUT,), c.dtype).at[tgt].set(c, mode="drop") for c in outs]
+    return live, cols
+
+
+bench("compact 8M -> 32k (cumsum + 8 scatters)", compact2, ok, outs)

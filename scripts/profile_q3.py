@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Per-node profile of a TPC-H query: patches Executor._exec to block on each
+node's output, so per-stage device time becomes visible (the block changes the
+total — dispatch no longer overlaps — but shows where the time goes)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sf = float(os.environ.get("BENCH_SF", "1"))
+q = os.environ.get("Q", "q3")
+
+from igloo_tpu.bench.tpch import QUERIES, gen_tables, register_all
+from igloo_tpu.engine import QueryEngine
+from igloo_tpu.exec.executor import Executor
+import jax
+
+print(f"device={jax.devices()[0]}", file=sys.stderr)
+t0 = time.perf_counter()
+tables = gen_tables(sf=sf)
+print(f"gen sf={sf}: {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+engine = QueryEngine()
+register_all(engine, tables)
+sql = QUERIES[q]
+
+# cold
+t0 = time.perf_counter()
+engine.execute(sql)
+print(f"cold: {time.perf_counter()-t0:.2f}s", file=sys.stderr)
+
+# warm unpatched (3 runs)
+for i in range(3):
+    engine.result_cache.clear()
+    t0 = time.perf_counter()
+    engine.execute(sql)
+    print(f"warm[{i}]: {time.perf_counter()-t0:.4f}s", file=sys.stderr)
+
+# patched per-node timing
+orig = Executor._exec
+depth = [0]
+
+def timed(self, plan):
+    depth[0] += 1
+    d = depth[0]
+    t0 = time.perf_counter()
+    out = orig(self, plan)
+    jax.block_until_ready([c.values for c in out.columns] + [out.live])
+    dt = time.perf_counter() - t0
+    depth[0] -= 1
+    name = type(plan).__name__
+    extra = ""
+    if name == "Scan":
+        extra = f" table={plan.table}"
+    print(f"{'  '*d}{name}{extra}: {dt:.4f}s cap={out.capacity}",
+          file=sys.stderr)
+    return out
+
+Executor._exec = timed
+engine.result_cache.clear()
+t0 = time.perf_counter()
+engine.execute(sql)
+print(f"patched total: {time.perf_counter()-t0:.4f}s", file=sys.stderr)

@@ -1,0 +1,146 @@
+"""Whole-plan fusion (exec/fused.py): hint adoption, stale-hint repair,
+duplicate-key negative cache, and fused-vs-staged result equality.
+
+The fused path is the default executor route; these tests drive the adaptive
+capacity-hint machinery explicitly across repeated executions and data changes
+— states the single-run TPC-H suite never reaches."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from igloo_tpu.engine import QueryEngine
+from igloo_tpu.exec import fused as F
+from igloo_tpu.utils import tracing
+
+
+def _mk_tables(n_fact: int, n_dim: int, match_every: int, seed: int = 3):
+    """Fact/dim pair: fact.fk hits dim.k for one row in `match_every`
+    (others point at key 0, absent from dim: k starts at 1)."""
+    rng = np.random.default_rng(seed)
+    fk = np.where(np.arange(n_fact) % match_every == 0,
+                  rng.integers(1, n_dim + 1, n_fact), 0)
+    fact = pa.table({
+        "fk": pa.array(fk, type=pa.int64()),
+        "w": pa.array(rng.integers(0, 100, n_fact), type=pa.int64()),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(1, n_dim + 1), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 100, n_dim), type=pa.int64()),
+    })
+    return fact, dim
+
+
+# big enough that the join/filter outputs clear ADAPTIVE_CAPACITY
+N_FACT = F.ADAPTIVE_CAPACITY * 2 + 17
+SQL = "SELECT sum(w + v) AS s, count(*) AS c FROM fact JOIN dim ON fk = k"
+
+
+def _oracle(fact: pa.Table, dim: pa.Table):
+    f = fact.to_pandas()
+    d = dim.to_pandas()
+    j = f.merge(d, left_on="fk", right_on="k")
+    return int((j.w + j.v).sum()), len(j)
+
+
+def test_hint_adoption_and_stale_hint_repair():
+    fact, dim = _mk_tables(N_FACT, 1000, match_every=64)
+    e = QueryEngine()
+    e.register_table("fact", fact)
+    e.register_table("dim", dim)
+
+    s, c = _oracle(fact, dim)
+    # run 1: no hints -> eager full-width join, records cardinalities
+    tracing.reset_counters()
+    t = e.execute(SQL)
+    assert (t.column("s")[0].as_py(), t.column("c")[0].as_py()) == (s, c)
+    assert tracing.counters().get("fused.execute", 0) >= 1
+    hints = [k for k in e._jit_cache if isinstance(k, tuple) and k[0] == "nhint"]
+    assert hints, "expected cardinality hints after the first run"
+
+    # run 2: hinted lazy/compacted program, same answer
+    e.result_cache.clear()
+    tracing.reset_counters()
+    t = e.execute(SQL)
+    assert (t.column("s")[0].as_py(), t.column("c")[0].as_py()) == (s, c)
+    assert not tracing.counters().get("fused.compact_repair")
+
+    # same shapes/bounds but ~16x more matches: the stale hint under-sizes the
+    # compaction, the overflow flag fires, and ONE repair re-run fixes it
+    fact2, _ = _mk_tables(N_FACT, 1000, match_every=4, seed=3)
+    e.register_table("fact", fact2)
+    s2, c2 = _oracle(fact2, dim)
+    assert c2 > 4 * c
+    e.result_cache.clear()
+    tracing.reset_counters()
+    t = e.execute(SQL)
+    assert (t.column("s")[0].as_py(), t.column("c")[0].as_py()) == (s2, c2)
+    assert tracing.counters().get("fused.compact_repair", 0) == 1
+
+    # run 4: hints refreshed, no repair
+    e.result_cache.clear()
+    tracing.reset_counters()
+    t = e.execute(SQL)
+    assert (t.column("s")[0].as_py(), t.column("c")[0].as_py()) == (s2, c2)
+    assert not tracing.counters().get("fused.compact_repair")
+
+
+def test_duplicate_build_keys_negative_cache():
+    # build side (smaller, dense bounds) has duplicate keys -> the direct
+    # attempt must flag, fall back exactly, and not be retried next run
+    dup_dim = pa.table({
+        "k": pa.array([1, 1, 2, 3, 4, 5, 6, 7], type=pa.int64()),
+        "v": pa.array([10, 11, 20, 30, 40, 50, 60, 70], type=pa.int64()),
+    })
+    fact = pa.table({
+        "fk": pa.array([1, 2, 2, 5, 9], type=pa.int64()),
+        "w": pa.array([1, 2, 3, 4, 5], type=pa.int64()),
+    })
+    e = QueryEngine()
+    e.register_table("fact", fact)
+    e.register_table("dim", dup_dim)
+    sql = "SELECT fk, w, v FROM fact JOIN dim ON fk = k ORDER BY fk, w, v"
+    want = {"fk": [1, 1, 2, 2, 5], "w": [1, 1, 2, 3, 4],
+            "v": [10, 11, 20, 20, 50]}
+
+    tracing.reset_counters()
+    t = e.execute(sql)
+    assert t.to_pydict() == want
+    assert tracing.counters().get("join.direct_dup_fallback", 0) >= 1
+    assert any(isinstance(k, tuple) and k[0] == "nodirect"
+               for k in e._jit_cache)
+
+    # the negative cache is PER SIDE: the next run may probe the other side
+    # (also duplicated here) and fall back once more — but results stay exact
+    e.result_cache.clear()
+    t = e.execute(sql)
+    assert t.to_pydict() == want
+
+    # both sides proven duplicated: sorted path compiled up front, no fallback
+    e.result_cache.clear()
+    tracing.reset_counters()
+    t = e.execute(sql)
+    assert t.to_pydict() == want
+    assert not tracing.counters().get("join.direct_dup_fallback")
+
+
+@pytest.mark.parametrize("jointype,exp", [
+    ("JOIN", {"fk": [1, 2, 2], "w": [1, 2, 3], "v": [10, 20, 20]}),
+    ("LEFT JOIN", {"fk": [1, 2, 2, 5, 9], "w": [1, 2, 3, 4, 5],
+                   "v": [10, 20, 20, None, None]}),
+])
+def test_fused_matches_staged(jointype, exp):
+    dim = pa.table({"k": pa.array([1, 2, 3], type=pa.int64()),
+                    "v": pa.array([10, 20, 30], type=pa.int64())})
+    fact = pa.table({"fk": pa.array([1, 2, 2, 5, 9], type=pa.int64()),
+                     "w": pa.array([1, 2, 3, 4, 5], type=pa.int64())})
+    e = QueryEngine()
+    e.register_table("fact", fact)
+    e.register_table("dim", dim)
+    sql = f"SELECT fk, w, v FROM fact {jointype} dim ON fk = k ORDER BY w"
+    t = e.execute(sql)
+    assert t.to_pydict() == exp
+    # force the staged route for the identical plan
+    from igloo_tpu.exec.executor import Executor
+    ex = Executor(e._jit_cache, batch_cache=e.batch_cache)
+    t2 = ex._staged_to_arrow(e.plan(sql))
+    assert t2.to_pydict() == exp
