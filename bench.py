@@ -82,7 +82,7 @@ def _spread(times):
 
 
 def bench_block(sf: float, queries: list[str], trials: int,
-                pandas_too: bool = True) -> dict:
+                pandas_too: bool = True) -> tuple[dict, list, list]:
     from igloo_tpu.bench.tpch import QUERIES, gen_tables, register_all
     from igloo_tpu.bench.tpch_pandas import PANDAS_QUERIES
     from igloo_tpu.engine import QueryEngine

@@ -80,6 +80,12 @@ def expr_to_json(e: Optional[E.Expr]):
                  distinct=e.distinct)
     elif isinstance(e, E.Alias):
         d.update(operand=expr_to_json(e.operand), alias=e.alias)
+    elif isinstance(e, E.Window):
+        d.update(func=e.func, agg=expr_to_json(e.agg),
+                 args=[expr_to_json(a) for a in e.args],
+                 partition=[expr_to_json(x) for x in e.partition_by],
+                 order=[expr_to_json(x) for x in e.order_by],
+                 ascending=e.ascending, nulls_first=e.nulls_first)
     elif isinstance(e, E.ScalarSubquery):
         if not isinstance(e.query, L.LogicalPlan):
             raise PlanError("cannot serialize unbound scalar subquery")
@@ -124,6 +130,12 @@ def expr_from_json(d) -> Optional[E.Expr]:
                    negated=d["negated"], case_insensitive=d["ci"])
     elif t == "Func":
         e = E.Func(name=d["name"], args=[expr_from_json(a) for a in d["args"]])
+    elif t == "Window":
+        e = E.Window(func=d["func"], agg=expr_from_json(d["agg"]),
+                     args=[expr_from_json(a) for a in d["args"]],
+                     partition_by=[expr_from_json(x) for x in d["partition"]],
+                     order_by=[expr_from_json(x) for x in d["order"]],
+                     ascending=d["ascending"], nulls_first=d["nulls_first"])
     elif t == "Aggregate":
         e = E.Aggregate(func=E.AggFunc(d["func"]), arg=expr_from_json(d["arg"]),
                         distinct=d["distinct"])
@@ -171,6 +183,12 @@ def plan_to_json(p: L.LogicalPlan) -> dict:
                  lk=[expr_to_json(e) for e in p.left_keys],
                  rk=[expr_to_json(e) for e in p.right_keys],
                  residual=expr_to_json(p.residual))
+    elif isinstance(p, L.Window):
+        d.update(input=plan_to_json(p.input),
+                 partition=[expr_to_json(e) for e in p.partition_exprs],
+                 order=[expr_to_json(e) for e in p.order_exprs],
+                 ascending=p.ascending, nulls_first=p.nulls_first,
+                 funcs=[expr_to_json(e) for e in p.funcs], names=p.names)
     elif isinstance(p, L.Sort):
         d.update(input=plan_to_json(p.input),
                  keys=[expr_to_json(e) for e in p.keys],
@@ -223,6 +241,13 @@ def plan_from_json(d: dict, catalog) -> L.LogicalPlan:
                    left_keys=[_rx(e, catalog) for e in d["lk"]],
                    right_keys=[_rx(e, catalog) for e in d["rk"]],
                    residual=_rx(d["residual"], catalog))
+    elif t == "Window":
+        p = L.Window(input=plan_from_json(d["input"], catalog),
+                     partition_exprs=[_rx(e, catalog) for e in d["partition"]],
+                     order_exprs=[_rx(e, catalog) for e in d["order"]],
+                     ascending=d["ascending"], nulls_first=d["nulls_first"],
+                     funcs=[_rx(e, catalog) for e in d["funcs"]],
+                     names=d["names"])
     elif t == "Sort":
         p = L.Sort(input=plan_from_json(d["input"], catalog),
                    keys=[_rx(e, catalog) for e in d["keys"]],

@@ -75,7 +75,11 @@ class DbApiTable:
         return t.schema
 
     def _fetch(self, sql: str) -> pa.Table:
-        conn = self._connect()
+        try:
+            conn = self._connect()
+        except Exception as ex:
+            raise ConnectorError(
+                f"cannot connect to remote database: {ex}") from None
         try:
             cur = conn.cursor()
             cur.execute(sql)
@@ -120,17 +124,21 @@ class DbApiTable:
 
 
 class PostgresTable(DbApiTable):
-    """Postgres federation source (reference crates/connectors/postgres, stub)."""
+    """Postgres federation source (reference crates/connectors/postgres, stub).
+
+    Uses psycopg2 when installed; otherwise falls back to the bundled
+    pure-Python wire-protocol client (connectors/pgwire.py — protocol v3,
+    simple query, trust/cleartext auth), so federation works without binary
+    drivers."""
 
     def __init__(self, dsn: str, table: str):
         try:
             import psycopg2  # type: ignore
+            connect = lambda: psycopg2.connect(dsn)  # noqa: E731
         except ImportError:
-            raise ConnectorError(
-                "postgres connector requires psycopg2 (not bundled in this "
-                "environment); install it or use DbApiTable with your own "
-                "driver") from None
-        super().__init__(lambda: psycopg2.connect(dsn), table, quote='"')
+            from igloo_tpu.connectors import pgwire
+            connect = lambda: pgwire.connect(dsn)  # noqa: E731
+        super().__init__(connect, table, quote='"')
 
 
 class MySqlTable(DbApiTable):

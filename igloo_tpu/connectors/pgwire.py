@@ -1,0 +1,218 @@
+"""Minimal pure-Python PostgreSQL wire-protocol (v3) client.
+
+The reference declares a postgres connector and ships an empty crate
+(crates/connectors/postgres/src/lib.rs:1). The federation core here
+(connectors/dbapi.py) speaks to any DBAPI driver; this module removes the
+"requires psycopg2" gap in environments without binary drivers: a small
+DBAPI-shaped client that speaks the actual postgres wire protocol — startup,
+cleartext/trust auth, simple Query ('Q'), RowDescription/DataRow decoding in
+text format, and error surfacing.
+
+Supported surface (what the connector needs): connect() -> Connection;
+Connection.cursor(); Cursor.execute(sql); Cursor.description;
+Cursor.fetchall(); close(). Results decode by type OID: ints, floats,
+numeric, bool, text, date, timestamp.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import socket
+import struct
+from typing import Optional
+
+PROTOCOL_V3 = 196608  # 3 << 16
+
+# type OID -> python converter (text format)
+_OID_BOOL = 16
+_OID_INT8 = 20
+_OID_INT2 = 21
+_OID_INT4 = 23
+_OID_TEXT = 25
+_OID_FLOAT4 = 700
+_OID_FLOAT8 = 701
+_OID_VARCHAR = 1043
+_OID_DATE = 1082
+_OID_TIMESTAMP = 1114
+_OID_NUMERIC = 1700
+
+
+def _conv_for(oid: int):
+    if oid in (_OID_INT2, _OID_INT4, _OID_INT8):
+        return int
+    if oid in (_OID_FLOAT4, _OID_FLOAT8, _OID_NUMERIC):
+        return float
+    if oid == _OID_BOOL:
+        return lambda s: s == "t"
+    if oid == _OID_DATE:
+        return _dt.date.fromisoformat
+    if oid == _OID_TIMESTAMP:
+        return lambda s: _dt.datetime.fromisoformat(s)
+    return lambda s: s
+
+
+class PgWireError(Exception):
+    pass
+
+
+class Cursor:
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+        self.description = None
+        self._rows: list[tuple] = []
+
+    def execute(self, sql: str) -> None:
+        self.description, self._rows = self._conn._query(sql)
+
+    def fetchall(self) -> list[tuple]:
+        return self._rows
+
+    def fetchone(self):
+        return self._rows[0] if self._rows else None
+
+    def close(self) -> None:
+        pass
+
+
+class Connection:
+    """One TCP connection speaking the simple-query subprotocol."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 5432,
+                 user: str = "igloo", dbname: str = "postgres",
+                 password: Optional[str] = None, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+        params = f"user\0{user}\0database\0{dbname}\0\0".encode()
+        pkt = struct.pack("!ii", 8 + len(params), PROTOCOL_V3) + params
+        self._sock.sendall(pkt)
+        self._auth(password)
+
+    # --- wire plumbing ---
+
+    def _recv_msg(self):
+        while len(self._buf) < 5:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise PgWireError("server closed connection")
+            self._buf += chunk
+        tag = self._buf[0:1]
+        (length,) = struct.unpack("!i", self._buf[1:5])
+        while len(self._buf) < 1 + length:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise PgWireError("server closed connection mid-message")
+            self._buf += chunk
+        body = self._buf[5: 1 + length]
+        self._buf = self._buf[1 + length:]
+        return tag, body
+
+    def _send(self, tag: bytes, body: bytes) -> None:
+        self._sock.sendall(tag + struct.pack("!i", 4 + len(body)) + body)
+
+    @staticmethod
+    def _error_message(body: bytes) -> str:
+        fields = {}
+        for part in body.split(b"\0"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode("utf-8", "replace")
+        return fields.get("M", "unknown server error")
+
+    def _auth(self, password: Optional[str]) -> None:
+        while True:
+            tag, body = self._recv_msg()
+            if tag == b"R":
+                (code,) = struct.unpack("!i", body[:4])
+                if code == 0:
+                    continue  # AuthenticationOk
+                if code == 3:  # cleartext password
+                    if password is None:
+                        raise PgWireError("server wants a password")
+                    self._send(b"p", password.encode() + b"\0")
+                    continue
+                raise PgWireError(f"unsupported auth method {code} "
+                                  "(only trust/cleartext)")
+            elif tag in (b"S", b"K", b"N"):
+                continue  # ParameterStatus / BackendKeyData / Notice
+            elif tag == b"Z":
+                return  # ReadyForQuery
+            elif tag == b"E":
+                raise PgWireError(self._error_message(body))
+            else:
+                raise PgWireError(f"unexpected message {tag!r} during startup")
+
+    # --- queries ---
+
+    def _query(self, sql: str):
+        self._send(b"Q", sql.encode() + b"\0")
+        description = None
+        convs: list = []
+        rows: list[tuple] = []
+        error: Optional[str] = None
+        while True:
+            tag, body = self._recv_msg()
+            if tag == b"T":  # RowDescription
+                (nf,) = struct.unpack("!h", body[:2])
+                off = 2
+                description = []
+                convs = []
+                for _ in range(nf):
+                    end = body.index(b"\0", off)
+                    name = body[off:end].decode()
+                    off = end + 1
+                    _tbl, _col, oid, _len, _mod, _fmt = struct.unpack(
+                        "!ihihih", body[off: off + 18])
+                    off += 18
+                    description.append((name, oid, None, None, None, None,
+                                        None))
+                    convs.append(_conv_for(oid))
+            elif tag == b"D":  # DataRow
+                (nf,) = struct.unpack("!h", body[:2])
+                off = 2
+                vals = []
+                for i in range(nf):
+                    (ln,) = struct.unpack("!i", body[off: off + 4])
+                    off += 4
+                    if ln == -1:
+                        vals.append(None)
+                    else:
+                        raw = body[off: off + ln].decode()
+                        off += ln
+                        vals.append(convs[i](raw) if i < len(convs) else raw)
+                rows.append(tuple(vals))
+            elif tag == b"C":  # CommandComplete
+                continue
+            elif tag == b"E":
+                error = self._error_message(body)
+            elif tag == b"Z":  # ReadyForQuery: transaction boundary
+                if error is not None:
+                    raise PgWireError(error)
+                return description, rows
+            elif tag in (b"S", b"N"):
+                continue
+            else:
+                raise PgWireError(f"unexpected message {tag!r} during query")
+
+    def cursor(self) -> Cursor:
+        return Cursor(self)
+
+    def close(self) -> None:
+        try:
+            self._send(b"X", b"")
+        except Exception:
+            pass
+        self._sock.close()
+
+
+def connect(dsn: str = "", **kw) -> Connection:
+    """DSN form: 'host=... port=... user=... dbname=... password=...'."""
+    params: dict = {}
+    for part in dsn.split():
+        k, _, v = part.partition("=")
+        params[k] = v
+    params.update(kw)
+    return Connection(
+        host=params.get("host", "127.0.0.1"),
+        port=int(params.get("port", 5432)),
+        user=params.get("user", "igloo"),
+        dbname=params.get("dbname", params.get("database", "postgres")),
+        password=params.get("password"),
+    )

@@ -681,6 +681,27 @@ class Executor:
             return self._maybe_shrink(out, known_live=total)
         return self._maybe_shrink(out)
 
+    def _exec_window(self, plan: L.Window) -> DeviceBatch:
+        from igloo_tpu.exec.window import compile_window, window_batch
+        batch = self._exec(plan.input)
+        comp = ExprCompiler([c.dictionary for c in batch.columns],
+                            bounds=[c.bounds for c in batch.columns])
+        wfp, pk, okeys, specs, wdicts, wbounds = compile_window(
+            plan, comp, self._resolve_subqueries)
+        fp = ("window", wfp, batch_proto_key(batch), plan.schema,
+              comp.pool.signature(), tuple(comp.marks))
+        asc, nf = list(plan.ascending), list(plan.nulls_first)
+
+        def build():
+            def fn(b, consts):
+                return window_batch(b, pk, okeys, asc, nf, specs,
+                                    plan.schema, consts)
+            return fn
+        out = self._jitted("window", fp, build)(strip_dicts(batch),
+                                                comp.pool.device_args())
+        dicts, bnds = col_meta(batch.columns)
+        return attach_dicts(out, dicts + wdicts, bnds + wbounds)
+
     def _exec_sort(self, plan: L.Sort) -> DeviceBatch:
         from igloo_tpu.exec.expr_compile import rank_lane
         batch = self._exec(plan.input)

@@ -497,6 +497,23 @@ class FusedCompiler:
             return distinct_batch(cfn(leaves, consts, ctx))
         return fn, meta
 
+    def _c_window(self, plan: L.Window):
+        from igloo_tpu.exec.window import compile_window, window_batch
+        cfn, meta = self._c(plan.input)
+        comp = self._compiler_for(meta)
+        wfp, pk, okeys, specs, wdicts, wbounds = compile_window(
+            plan, comp, self.ex._resolve_subqueries)
+        self.marks.extend(comp.marks)
+        self._push(("window", wfp, plan.schema))
+        asc, nf = list(plan.ascending), list(plan.nulls_first)
+        out_schema = plan.schema
+
+        def fn(leaves, consts, ctx):
+            return window_batch(cfn(leaves, consts, ctx), pk, okeys, asc, nf,
+                                specs, out_schema, consts)
+        return fn, NodeMeta(out_schema, list(meta.dicts) + wdicts,
+                            list(meta.bounds) + wbounds, meta.capacity)
+
     # --- ordering ---------------------------------------------------------
 
     def _c_sort(self, plan: L.Sort):

@@ -270,6 +270,14 @@ class Binder:
                 raise PlanError("HAVING predicate must be boolean")
             plan = self._filter(plan, bound_having)
 
+        # window functions: hoist E.Window subexpressions into Window plan
+        # nodes (one per distinct OVER spec) below the projection
+        if any(self._contains_window(b) for b in bound_proj):
+            if has_aggs:
+                raise PlanError("window functions combined with GROUP BY / "
+                                "aggregates are not supported yet")
+            plan, bound_proj = self._build_windows(plan, bound_proj)
+
         # projection node
         proj_node = L.Project(input=plan, exprs=bound_proj, names=list(names))
         proj_node.schema = T.Schema([
@@ -369,6 +377,59 @@ class Binder:
             lim.schema = plan.schema
             plan = lim
         return plan
+
+    # --- window functions ---
+
+    @staticmethod
+    def _contains_window(e: E.Expr) -> bool:
+        return any(isinstance(n, E.Window) for n in E.walk(e))
+
+    def _build_windows(self, plan, bound_proj):
+        """Hoist bound E.Window subexpressions into stacked L.Window nodes
+        (one per distinct OVER spec; each preserves its input columns and
+        appends one column per function), rewriting the projections to
+        reference the appended columns."""
+        specs: dict = {}
+        order_specs: list = []
+        for b in bound_proj:
+            for n in E.walk(b):
+                if not isinstance(n, E.Window):
+                    continue
+                skey = (tuple(repr(p) for p in n.partition_by),
+                        tuple(repr(o) for o in n.order_by),
+                        tuple(n.ascending), tuple(n.nulls_first))
+                if skey not in specs:
+                    specs[skey] = (n, [], [])
+                    order_specs.append(skey)
+                _, wins, reprs = specs[skey]
+                r = repr(n)
+                if r not in reprs:
+                    wins.append(n)
+                    reprs.append(r)
+        col_of: dict[str, E.Column] = {}
+        for skey in order_specs:
+            proto, wins, reprs = specs[skey]
+            base = len(plan.schema)
+            names = [f"__win_{base + i}" for i in range(len(wins))]
+            node = L.Window(input=plan, partition_exprs=proto.partition_by,
+                            order_exprs=proto.order_by,
+                            ascending=list(proto.ascending),
+                            nulls_first=list(proto.nulls_first),
+                            funcs=wins, names=names)
+            node.schema = T.Schema(
+                list(plan.schema.fields) +
+                [T.Field(nm, w.dtype, True) for nm, w in zip(names, wins)])
+            plan = node
+            for i, r in enumerate(reprs):
+                c = E.Column(names[i], index=base + i)
+                c.dtype = wins[i].dtype
+                col_of[r] = c
+
+        def sub(n):
+            if isinstance(n, E.Window):
+                return col_of[repr(n)]
+            return n
+        return plan, [E.transform(b, sub) for b in bound_proj]
 
     def _resolve_positional(self, ex: E.Expr, projections, out_schema=None) -> E.Expr:
         if isinstance(ex, E.Literal) and isinstance(ex.value, int) \
@@ -1144,6 +1205,32 @@ class Binder:
                 n.dtype = out if out != T.NULL else T.INT32
             else:
                 raise PlanError(f"unknown function: {name}")
+            return n
+        if isinstance(e, E.Window):
+            args = [self._bind_e(a, scope) for a in e.args]
+            part = [self._bind_e(p, scope) for p in e.partition_by]
+            order = [self._bind_e(o, scope) for o in e.order_by]
+            agg = None
+            if e.agg is not None:
+                warg = self._bind_e(e.agg.arg, scope) \
+                    if e.agg.arg is not None else None
+                agg = E.Aggregate(func=e.agg.func, arg=warg)
+                agg.dtype = agg_result_type(
+                    e.agg.func, warg.dtype if warg is not None else None)
+            n = E.Window(func=e.func, agg=agg, args=args, partition_by=part,
+                         order_by=order, ascending=list(e.ascending),
+                         nulls_first=list(e.nulls_first))
+            if e.func == "agg":
+                n.dtype = agg.dtype
+            elif e.func in ("lag", "lead"):
+                if len(args) == 2 and not (
+                        isinstance(args[1], E.Literal)
+                        and isinstance(args[1].value, int)):
+                    raise PlanError(f"{e.func} offset must be an integer "
+                                    "literal")
+                n.dtype = args[0].dtype
+            else:
+                n.dtype = T.INT64
             return n
         if isinstance(e, E.Aggregate):
             arg = self._bind_e(e.arg, scope) if e.arg is not None else None

@@ -241,6 +241,42 @@ class Aggregate(Expr):
 
 
 @dataclass
+class Window(Expr):
+    """Window function: fn(args) OVER (PARTITION BY ... ORDER BY ...).
+
+    `func` is "row_number" | "rank" | "dense_rank" | "lag" | "lead", or an
+    aggregate applied over the window (`agg` set, func == "agg"). With an
+    ORDER BY, aggregates use the SQL default frame (RANGE UNBOUNDED PRECEDING
+    .. CURRENT ROW — running totals over peer groups); without one they span
+    the whole partition. The reference executes these through DataFusion
+    (crates/engine/src/lib.rs:54-57); the TPU design is a segmented-scan
+    kernel (exec/window.py)."""
+    func: str = ""
+    agg: Optional["Aggregate"] = None
+    args: list[Expr] = dc_field(default_factory=list)   # lag/lead: value[, offset]
+    partition_by: list[Expr] = dc_field(default_factory=list)
+    order_by: list[Expr] = dc_field(default_factory=list)
+    ascending: list[bool] = dc_field(default_factory=list)
+    nulls_first: list[bool] = dc_field(default_factory=list)
+
+    def children(self):
+        out = list(self.args) + list(self.partition_by) + list(self.order_by)
+        if self.agg is not None and self.agg.arg is not None:
+            out.append(self.agg.arg)
+        return out
+
+    def name_hint(self) -> str:
+        return self.agg.name_hint() if self.agg is not None else self.func
+
+    def __repr__(self) -> str:
+        inner = repr(self.agg) if self.agg is not None else \
+            f"{self.func}({self.args!r})"
+        return (f"window({inner} part={self.partition_by!r} "
+                f"order={self.order_by!r} asc={self.ascending} "
+                f"nf={self.nulls_first})")
+
+
+@dataclass
 class Alias(Expr):
     operand: Expr = None  # type: ignore[assignment]
     alias: str = ""
@@ -326,6 +362,12 @@ def transform(e: Expr, fn) -> Expr:
         n.operand = transform(n.operand, fn)
     elif isinstance(n, InSubquery):
         n.operand = transform(n.operand, fn)
+    elif isinstance(n, Window):
+        n.args = [transform(a, fn) for a in n.args]
+        n.partition_by = [transform(p, fn) for p in n.partition_by]
+        n.order_by = [transform(o, fn) for o in n.order_by]
+        if n.agg is not None:
+            n.agg = transform(n.agg, fn)
     return fn(n)
 
 

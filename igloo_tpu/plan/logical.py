@@ -117,6 +117,29 @@ class Join(LogicalPlan):
 
 
 @dataclass
+class Window(LogicalPlan):
+    """Window-function evaluation: output = input columns + one column per
+    entry in `funcs` (bound E.Window exprs sharing this node's single
+    PARTITION BY / ORDER BY spec; the binder stacks one node per distinct
+    spec). Row ORDER of the output batch is unspecified (like every
+    non-Sort node); only the VALUES are window-ordered."""
+    input: LogicalPlan = None  # type: ignore[assignment]
+    partition_exprs: list[E.Expr] = field(default_factory=list)
+    order_exprs: list[E.Expr] = field(default_factory=list)
+    ascending: list[bool] = field(default_factory=list)
+    nulls_first: list[bool] = field(default_factory=list)
+    funcs: list[E.Expr] = field(default_factory=list)   # bound E.Window nodes
+    names: list[str] = field(default_factory=list)
+
+    def children(self):
+        return [self.input]
+
+    def node_name(self):
+        return (f"Window({', '.join(self.names)} part="
+                f"{len(self.partition_exprs)} order={len(self.order_exprs)})")
+
+
+@dataclass
 class Sort(LogicalPlan):
     input: LogicalPlan = None  # type: ignore[assignment]
     keys: list[E.Expr] = field(default_factory=list)  # bound against input schema
@@ -205,6 +228,14 @@ def copy_plan(plan: LogicalPlan) -> LogicalPlan:
         n.left_keys = [_copy.deepcopy(e) for e in n.left_keys]
         n.right_keys = [_copy.deepcopy(e) for e in n.right_keys]
         n.residual = _copy.deepcopy(n.residual) if n.residual is not None else None
+    elif isinstance(n, Window):
+        n.input = copy_plan(n.input)
+        n.partition_exprs = [_copy.deepcopy(e) for e in n.partition_exprs]
+        n.order_exprs = [_copy.deepcopy(e) for e in n.order_exprs]
+        n.ascending = list(n.ascending)
+        n.nulls_first = list(n.nulls_first)
+        n.funcs = [_copy.deepcopy(e) for e in n.funcs]
+        n.names = list(n.names)
     elif isinstance(n, Sort):
         n.input = copy_plan(n.input)
         n.keys = [_copy.deepcopy(e) for e in n.keys]

@@ -30,9 +30,140 @@ from igloo_tpu.sql.ast import JoinType
 
 def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
     plan = fold_constants_pass(plan)
+    plan = reorder_cross_joins(plan)
     plan = pushdown_filters(plan)
     plan = prune_projections(plan)
     return plan
+
+
+# --- join reorder (cross-product avoidance) ---------------------------------------
+
+
+def reorder_cross_joins(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Reorder a comma-FROM cross-join chain by WHERE-predicate connectivity.
+
+    The binder builds comma FROM lists as a left-deep CROSS chain in written
+    order; pushdown turns spanning equalities into join keys PAIRWISE, so a
+    prefix with no predicate edge stays a true cross join — TPC-H Q2's
+    `FROM part, supplier, partsupp, ...` becomes part x supplier, an |P|x|S|
+    candidate expansion whose static-shape program is catastrophic (the
+    expand at 8M lanes compiles for tens of minutes on TPU).
+
+    This pass flattens Filter-over-pure-CROSS chains and checks prefix
+    connectivity under the filter's conjuncts. Chains already connected in
+    written order are returned UNCHANGED (plans stay bit-identical); otherwise
+    relations greedily reorder so every join has at least one predicate edge
+    when one exists, and a Project on top restores the original column order
+    so everything above is untouched."""
+    for name in ("input", "left", "right"):
+        ch = getattr(plan, name, None)
+        if isinstance(ch, L.LogicalPlan):
+            setattr(plan, name, reorder_cross_joins(ch))
+    if isinstance(plan, L.Union):
+        plan.inputs = [reorder_cross_joins(c) for c in plan.inputs]
+    if not isinstance(plan, L.Filter):
+        return plan
+    # walk from the filter stack down to the cross chain through structures
+    # that preserve the chain's column indexes as a PREFIX: further Filters
+    # (conjuncts collected — the binder stacks one Filter per conjunct),
+    # identity-prefix Projects, and Join left spines (e.g. the decorrelation
+    # LEFT join wrapping the FROM chain)
+    conjuncts: list[E.Expr] = []
+    parent, pattr = None, None
+    node: L.LogicalPlan = plan
+    rels: list = []
+    while True:
+        if isinstance(node, L.Filter):
+            conjuncts += _split_conjuncts(node.predicate)
+            parent, pattr, node = node, "input", node.input
+        elif isinstance(node, L.Project) and _is_identity_prefix(node):
+            parent, pattr, node = node, "input", node.input
+        elif isinstance(node, L.Join):
+            rels = _flatten_cross(node)
+            if len(rels) >= 3:
+                break
+            parent, pattr, node = node, "left", node.left
+        else:
+            return plan
+    if len(rels) < 3:
+        return plan
+
+    offsets = []
+    off = 0
+    for r in rels:
+        offsets.append(off)
+        off += len(r.schema)
+
+    def rel_of(col_idx: int) -> int:
+        for i in range(len(rels) - 1, -1, -1):
+            if col_idx >= offsets[i]:
+                return i
+        return 0
+
+    width = off
+    edges: set[tuple[int, int]] = set()
+    for c in conjuncts:
+        cols = _cols_of(c)
+        if not cols or any(i >= width for i in cols):
+            continue  # references columns outside the chain
+        touched = {rel_of(i) for i in cols}
+        if len(touched) == 2:
+            a, b = sorted(touched)
+            edges.add((a, b))
+
+    def connected(i: int, placed: set[int]) -> bool:
+        return any((min(i, p), max(i, p)) in edges for p in placed)
+
+    order = [0]
+    remaining = list(range(1, len(rels)))
+    while remaining:
+        nxt = next((i for i in remaining if connected(i, set(order))),
+                   remaining[0])
+        order.append(nxt)
+        remaining.remove(nxt)
+    # written order already avoids cross products (or nothing improves):
+    # leave the plan bit-identical
+    if order == list(range(len(rels))):
+        return plan
+
+    chain = rels[order[0]]
+    for i in order[1:]:
+        j = L.Join(left=chain, right=rels[i], join_type=JoinType.CROSS)
+        j.schema = T.Schema(list(chain.schema.fields) +
+                            list(rels[i].schema.fields))
+        chain = j
+    # restore the ORIGINAL column order above the reordered chain
+    new_offsets = {}
+    off = 0
+    for i in order:
+        new_offsets[i] = off
+        off += len(rels[i].schema)
+    exprs, names = [], []
+    orig_schema = node.schema
+    for i, r in enumerate(rels):
+        for k, f in enumerate(r.schema.fields):
+            c = E.Column(f.name, index=new_offsets[i] + k)
+            c.dtype = f.dtype
+            exprs.append(c)
+            names.append(orig_schema.fields[offsets[i] + k].name)
+    proj = L.Project(input=chain, exprs=exprs, names=names)
+    proj.schema = orig_schema
+    setattr(parent, pattr, proj)
+    return plan
+
+
+def _flatten_cross(j: L.LogicalPlan) -> list[L.LogicalPlan]:
+    if isinstance(j, L.Join) and j.join_type is JoinType.CROSS \
+            and not j.left_keys and j.residual is None:
+        return _flatten_cross(j.left) + [j.right]
+    return [j]
+
+
+def _is_identity_prefix(p: L.Project) -> bool:
+    """Every projected expr is Column(index == position): the project only
+    drops trailing columns, so lower column indexes pass through unchanged."""
+    return all(isinstance(e, E.Column) and e.index == i
+               for i, e in enumerate(p.exprs))
 
 
 # --- constant folding -------------------------------------------------------------

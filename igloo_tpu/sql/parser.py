@@ -742,15 +742,18 @@ class Parser:
     _AGG_NAMES = {"sum": E.AggFunc.SUM, "count": E.AggFunc.COUNT, "min": E.AggFunc.MIN,
                   "max": E.AggFunc.MAX, "avg": E.AggFunc.AVG, "mean": E.AggFunc.AVG}
 
+    _WINDOW_ONLY = {"row_number", "rank", "dense_rank", "lag", "lead"}
+
     def parse_call(self, name: str) -> E.Expr:
         lname = name.lower()
         if self.try_op(")"):
-            return E.Func(name=lname, args=[])
+            return self._maybe_over(lname, [], E.Func(name=lname, args=[]))
         distinct = self.try_kw("DISTINCT") is not None
         if self.try_op("*"):
             self.expect_op(")")
             if lname == "count":
-                return E.Aggregate(func=E.AggFunc.COUNT_STAR)
+                return self._maybe_over(
+                    lname, [], E.Aggregate(func=E.AggFunc.COUNT_STAR))
             self.err(f"{name}(*) is only valid for count")
         args = [self.parse_expr()]
         while self.try_op(","):
@@ -759,10 +762,68 @@ class Parser:
         if lname in self._AGG_NAMES:
             if len(args) != 1:
                 raise SqlParseError(f"{name} takes exactly one argument")
-            return E.Aggregate(func=self._AGG_NAMES[lname], arg=args[0], distinct=distinct)
+            return self._maybe_over(lname, args, E.Aggregate(
+                func=self._AGG_NAMES[lname], arg=args[0], distinct=distinct))
         if distinct:
             self.err("DISTINCT only valid in aggregate functions")
-        return E.Func(name=lname, args=args)
+        return self._maybe_over(lname, args, E.Func(name=lname, args=args))
+
+    def _maybe_over(self, lname: str, args: list, plain: E.Expr) -> E.Expr:
+        """Attach an OVER (...) window spec, or return the plain call."""
+        if not self.try_kw("OVER"):
+            if lname in self._WINDOW_ONLY:
+                self.err(f"{lname}() requires an OVER (...) clause")
+            return plain
+        if isinstance(plain, E.Aggregate) and plain.distinct:
+            self.err("DISTINCT aggregates cannot be windowed")
+        self.expect_op("(")
+        partition: list[E.Expr] = []
+        order: list[E.Expr] = []
+        asc: list[bool] = []
+        nf: list = []
+        if self.try_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition.append(self.parse_expr())
+            while self.try_op(","):
+                partition.append(self.parse_expr())
+        if self.try_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                order.append(self.parse_expr())
+                a = True
+                if self.try_kw("ASC"):
+                    a = True
+                elif self.try_kw("DESC"):
+                    a = False
+                n = None
+                if self.try_kw("NULLS"):
+                    if self.try_kw("FIRST"):
+                        n = True
+                    else:
+                        self.expect_kw("LAST")
+                        n = False
+                asc.append(a)
+                nf.append(n if n is not None else not a)
+                if not self.try_op(","):
+                    break
+        self.expect_op(")")
+        if isinstance(plain, E.Aggregate):
+            return E.Window(func="agg", agg=plain, partition_by=partition,
+                            order_by=order, ascending=asc, nulls_first=nf)
+        if lname not in self._WINDOW_ONLY:
+            self.err(f"{lname}() cannot take an OVER clause")
+        if lname in ("row_number", "rank", "dense_rank"):
+            if args:
+                self.err(f"{lname}() takes no arguments")
+            if not order:
+                self.err(f"{lname}() requires ORDER BY in its OVER clause")
+        else:  # lag / lead
+            if not (1 <= len(args) <= 2):
+                self.err(f"{lname}() takes 1 or 2 arguments")
+            if not order:
+                self.err(f"{lname}() requires ORDER BY in its OVER clause")
+        return E.Window(func=lname, args=args, partition_by=partition,
+                        order_by=order, ascending=asc, nulls_first=nf)
 
     def parse_case(self) -> E.Expr:
         self.expect_kw("CASE")

@@ -1,0 +1,126 @@
+"""Test fixture: a minimal PostgreSQL wire-protocol (v3) SERVER backed by an
+in-memory sqlite database. Speaks the real protocol on a real TCP socket —
+startup, AuthenticationOk, simple Query, RowDescription/DataRow in text
+format, ErrorResponse — so the federation connector's postgres path is
+exercised over an actual wire conversation (round-3 verdict: the DBAPI core
+had only ever met sqlite3 in-process)."""
+from __future__ import annotations
+
+import datetime as _dt
+import socket
+import socketserver
+import sqlite3
+import struct
+import threading
+
+_OID_BOOL, _OID_INT8, _OID_TEXT, _OID_FLOAT8, _OID_DATE = 16, 20, 25, 701, 1082
+
+
+def _oid_for(v) -> int:
+    if isinstance(v, bool):
+        return _OID_BOOL
+    if isinstance(v, int):
+        return _OID_INT8
+    if isinstance(v, float):
+        return _OID_FLOAT8
+    if isinstance(v, (_dt.date, _dt.datetime)):
+        return _OID_DATE
+    return _OID_TEXT
+
+
+def _text(v) -> bytes:
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, (_dt.date, _dt.datetime)):
+        return v.isoformat().encode()
+    return str(v).encode()
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _send(self, tag: bytes, body: bytes) -> None:
+        self.request.sendall(tag + struct.pack("!i", 4 + len(body)) + body)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client closed")
+            buf += chunk
+        return buf
+
+    def handle(self) -> None:
+        # startup message (untagged): int32 len, int32 protocol, params
+        (length,) = struct.unpack("!i", self._recv_exact(4))
+        self._recv_exact(length - 4)
+        self._send(b"R", struct.pack("!i", 0))          # AuthenticationOk
+        self._send(b"S", b"server_version\0igloo-fake-14.0\0")
+        self._send(b"Z", b"I")                          # ReadyForQuery
+        conn = sqlite3.connect(":memory:")
+        self.server.populate(conn)
+        try:
+            while True:
+                try:
+                    tag = self._recv_exact(1)
+                except ConnectionError:
+                    return
+                (length,) = struct.unpack("!i", self._recv_exact(4))
+                body = self._recv_exact(length - 4)
+                if tag == b"X":
+                    return
+                if tag != b"Q":
+                    self._send(b"E", b"SERROR\0C0A000\0M"
+                               b"only simple Query supported\0\0")
+                    self._send(b"Z", b"I")
+                    continue
+                sql = body.rstrip(b"\0").decode()
+                try:
+                    cur = conn.execute(sql)
+                    rows = cur.fetchall()
+                    names = [d[0] for d in cur.description or []]
+                except Exception as ex:
+                    self._send(b"E", b"SERROR\0C42601\0M" +
+                               str(ex).encode() + b"\0\0")
+                    self._send(b"Z", b"I")
+                    continue
+                # RowDescription: infer OIDs from the first non-null value
+                fields = b""
+                for i, name in enumerate(names):
+                    sample = next((r[i] for r in rows if r[i] is not None),
+                                  "")
+                    fields += name.encode() + b"\0" + struct.pack(
+                        "!ihihih", 0, i + 1, _oid_for(sample), -1, -1, 0)
+                self._send(b"T", struct.pack("!h", len(names)) + fields)
+                for r in rows:
+                    out = struct.pack("!h", len(r))
+                    for v in r:
+                        if v is None:
+                            out += struct.pack("!i", -1)
+                        else:
+                            tv = _text(v)
+                            out += struct.pack("!i", len(tv)) + tv
+                    self._send(b"D", out)
+                self._send(b"C", f"SELECT {len(rows)}\0".encode())
+                self._send(b"Z", b"I")
+        finally:
+            conn.close()
+
+
+class FakePostgresServer(socketserver.ThreadingTCPServer):
+    """`with FakePostgresServer(populate) as port:` — populate(conn) seeds the
+    per-connection sqlite database."""
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, populate):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.populate = populate
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+
+    def __enter__(self) -> int:
+        self._thread.start()
+        return self.server_address[1]
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+        self.server_close()

@@ -352,8 +352,12 @@ class TestDbApi:
 
     def test_drivers_absent_is_clean_error(self):
         from igloo_tpu.connectors.dbapi import MySqlTable, PostgresTable
-        with pytest.raises(ConnectorError, match="psycopg2"):
-            PostgresTable("dsn", "t")
+        # postgres now bundles a pure-python wire driver (connectors/pgwire),
+        # so a missing binary driver is no longer an error — an unreachable
+        # server is, and it must surface as a clean ConnectorError (not a
+        # bare socket error) from the construction-time schema probe
+        with pytest.raises(ConnectorError, match="cannot connect"):
+            PostgresTable("host=127.0.0.1 port=1 user=u dbname=d", "t")
         with pytest.raises(ConnectorError, match="pymysql"):
             MySqlTable("t")
 
@@ -417,3 +421,67 @@ class TestFakeDbApiDriver:
         e.register_table("fake", DbApiTable(lambda: self._Conn(log), "things"))
         out = e.execute("SELECT name FROM fake WHERE id >= 2 ORDER BY name")
         assert out.column("name").to_pylist() == ["beta", "gamma"]
+
+
+# --- postgres wire protocol (round-4: federation meets a REAL wire) ---------
+
+def test_postgres_wire_federation():
+    """PostgresTable over the bundled pure-python wire client against a
+    protocol-v3 server: schema probe, projection + predicate pushdown, and a
+    federated join with an in-memory table — a real postgres-wire conversation
+    end to end (the reference's postgres crate is an empty stub)."""
+    import datetime as dt
+
+    import pyarrow as pa
+
+    from igloo_tpu.connectors.dbapi import PostgresTable
+    from igloo_tpu.engine import QueryEngine
+    from tests.pgwire_server import FakePostgresServer
+
+    def populate(conn):
+        conn.execute("CREATE TABLE accounts (id INTEGER, name TEXT, "
+                     "balance REAL, opened TEXT)")
+        conn.executemany(
+            "INSERT INTO accounts VALUES (?, ?, ?, ?)",
+            [(1, "alice", 120.5, "2023-01-01"),
+             (2, "bob", 80.0, "2023-02-15"),
+             (3, "carol", 200.25, "2023-03-30"),
+             (4, None, 10.0, "2023-04-02")])
+
+    with FakePostgresServer(populate) as port:
+        t = PostgresTable(f"host=127.0.0.1 port={port} user=u dbname=d",
+                          "accounts")
+        # schema probed over the wire
+        assert set(t.schema().names) == {"id", "name", "balance", "opened"}
+
+        engine = QueryEngine()
+        engine.register_table("accounts", t)
+        engine.register_table("tags", pa.table({
+            "acct": pa.array([1, 2, 3], type=pa.int64()),
+            "tag": ["vip", "std", "vip"],
+        }))
+        out = engine.execute("""
+            SELECT name, balance, tag FROM accounts JOIN tags ON id = acct
+            WHERE balance > 100 ORDER BY name
+        """).to_pydict()
+        assert out == {"name": ["alice", "carol"],
+                       "balance": [120.5, 200.25],
+                       "tag": ["vip", "vip"]}
+
+    # driver-level checks: NULLs and error surfacing over the wire
+    from igloo_tpu.connectors import pgwire
+    with FakePostgresServer(populate) as port:
+        conn = pgwire.connect(f"host=127.0.0.1 port={port} user=u dbname=d")
+        cur = conn.cursor()
+        cur.execute("SELECT name FROM accounts WHERE id = 4")
+        assert cur.fetchall() == [(None,)]
+        try:
+            cur.execute("SELECT nope FROM accounts")
+            raised = False
+        except pgwire.PgWireError as ex:
+            raised = "no such column" in str(ex)
+        assert raised
+        # the error must not poison the connection (ReadyForQuery resyncs)
+        cur.execute("SELECT count(*) FROM accounts")
+        assert cur.fetchall() == [(4,)]
+        conn.close()
