@@ -193,50 +193,15 @@ class DistributedPlanner:
         """agg over a local subtree -> per-partition partial fragments +
         final merge plan (returned for the parent fragment to execute)."""
         parts = self._partition_sets(agg.input)
-        k = len(agg.group_exprs)
-
-        # partial aggregate: groups + decomposed partials
-        partial_aggs: list[E.Aggregate] = []
-        partial_names: list[str] = []
-        final_plan: list[tuple] = []  # (kind, partial col index, orig agg)
-        pi = k
-        for a in agg.aggs:
-            if a.func in (E.AggFunc.COUNT, E.AggFunc.COUNT_STAR):
-                partial_aggs.append(a)
-                partial_names.append(f"p{pi}")
-                final_plan.append(("sum0", pi, a))
-                pi += 1
-            elif a.func is E.AggFunc.AVG:
-                s = E.Aggregate(func=E.AggFunc.SUM, arg=a.arg)
-                s.dtype = T.FLOAT64
-                c = E.Aggregate(func=E.AggFunc.COUNT, arg=a.arg)
-                c.dtype = T.INT64
-                partial_aggs.extend([s, c])
-                partial_names.extend([f"p{pi}", f"p{pi + 1}"])
-                final_plan.append(("avg", pi, a))
-                pi += 2
-            else:  # SUM / MIN / MAX: associative
-                partial_aggs.append(a)
-                partial_names.append(f"p{pi}")
-                final_plan.append(("assoc", pi, a))
-                pi += 1
-
-        partial_fields = [T.Field(n, g.dtype, True)
-                          for n, g in zip(agg.group_names, agg.group_exprs)]
-        partial_fields += [T.Field(n, a.dtype, True)
-                           for n, a in zip(partial_names, partial_aggs)]
-        partial_schema = T.Schema(partial_fields)
+        partial_schema, partial_aggs, partial_names, final_plan = \
+            decompose_aggregate(agg)
 
         children = []
         for part in parts:
             sub = _with_partition(agg.input, part) if part else \
                 L.copy_plan(agg.input)
-            node = L.Aggregate(input=sub,
-                               group_exprs=[g for g in agg.group_exprs],
-                               group_names=list(agg.group_names),
-                               aggs=list(partial_aggs),
-                               agg_names=list(partial_names))
-            node.schema = partial_schema
+            node = partial_aggregate_node(agg, sub, partial_schema,
+                                          partial_aggs, partial_names)
             f = self._make_fragment(node, frags, deps=[])
             children.append(_frag_scan(f))
         if len(children) == 1:
@@ -244,75 +209,133 @@ class DistributedPlanner:
         else:
             merged = L.Union(inputs=children)
             merged.schema = partial_schema
+        return final_merge_plan(agg, merged, final_plan)
 
-        # final merge: re-aggregate partials by the group columns
-        final_groups = [_col(i, g.dtype, agg.group_names[i])
-                        for i, g in enumerate(agg.group_exprs)]
-        final_aggs: list[E.Aggregate] = []
-        final_names: list[str] = []
-        for kind, pi_, a in final_plan:
-            if kind == "avg":
-                for j, dt in ((pi_, T.FLOAT64), (pi_ + 1, T.INT64)):
-                    fa = E.Aggregate(func=E.AggFunc.SUM, arg=_col(j, dt))
-                    fa.dtype = dt
-                    final_aggs.append(fa)
-                    final_names.append(f"f{j}")
-            else:
-                fn = E.AggFunc.SUM if kind == "sum0" else a.func
-                fa = E.Aggregate(func=fn, arg=_col(pi_, a.dtype))
-                fa.dtype = a.dtype
+
+def decompose_aggregate(agg: L.Aggregate):
+    """Decompose a DECOMPOSABLE aggregate into per-chunk partials: returns
+    (partial_schema, partial_aggs, partial_names, final_plan) where
+    final_plan records how final_merge_plan recombines partial columns.
+    Shared by the distributed planner, the chunked executor, and the
+    out-of-core grace join (exec/grace.py)."""
+    k = len(agg.group_exprs)
+    partial_aggs: list[E.Aggregate] = []
+    partial_names: list[str] = []
+    final_plan: list[tuple] = []  # (kind, partial col index, orig agg)
+    pi = k
+    for a in agg.aggs:
+        if a.func in (E.AggFunc.COUNT, E.AggFunc.COUNT_STAR):
+            partial_aggs.append(a)
+            partial_names.append(f"p{pi}")
+            final_plan.append(("sum0", pi, a))
+            pi += 1
+        elif a.func is E.AggFunc.AVG:
+            s = E.Aggregate(func=E.AggFunc.SUM, arg=a.arg)
+            s.dtype = T.FLOAT64
+            c = E.Aggregate(func=E.AggFunc.COUNT, arg=a.arg)
+            c.dtype = T.INT64
+            partial_aggs.extend([s, c])
+            partial_names.extend([f"p{pi}", f"p{pi + 1}"])
+            final_plan.append(("avg", pi, a))
+            pi += 2
+        else:  # SUM / MIN / MAX: associative
+            partial_aggs.append(a)
+            partial_names.append(f"p{pi}")
+            final_plan.append(("assoc", pi, a))
+            pi += 1
+
+    partial_fields = [T.Field(n, g.dtype, True)
+                      for n, g in zip(agg.group_names, agg.group_exprs)]
+    partial_fields += [T.Field(n, a.dtype, True)
+                       for n, a in zip(partial_names, partial_aggs)]
+    return T.Schema(partial_fields), partial_aggs, partial_names, final_plan
+
+
+def partial_aggregate_node(agg: L.Aggregate, inp: L.LogicalPlan,
+                           partial_schema, partial_aggs,
+                           partial_names) -> L.Aggregate:
+    node = L.Aggregate(input=inp,
+                       group_exprs=[g for g in agg.group_exprs],
+                       group_names=list(agg.group_names),
+                       aggs=list(partial_aggs),
+                       agg_names=list(partial_names))
+    node.schema = partial_schema
+    return node
+
+
+def final_merge_plan(agg: L.Aggregate, merged: L.LogicalPlan,
+                     final_plan: list[tuple]) -> L.LogicalPlan:
+    """Final re-aggregation of partial rows + projection back to the
+    aggregate's declared output schema."""
+    k = len(agg.group_exprs)
+    # final merge: re-aggregate partials by the group columns
+    final_groups = [_col(i, g.dtype, agg.group_names[i])
+                    for i, g in enumerate(agg.group_exprs)]
+    final_aggs: list[E.Aggregate] = []
+    final_names: list[str] = []
+    for kind, pi_, a in final_plan:
+        if kind == "avg":
+            for j, dt in ((pi_, T.FLOAT64), (pi_ + 1, T.INT64)):
+                fa = E.Aggregate(func=E.AggFunc.SUM, arg=_col(j, dt))
+                fa.dtype = dt
                 final_aggs.append(fa)
-                final_names.append(f"f{pi_}")
-        merge = L.Aggregate(input=merged, group_exprs=final_groups,
-                            group_names=list(agg.group_names),
-                            aggs=final_aggs, agg_names=final_names)
-        merge.schema = T.Schema(
-            [T.Field(n, g.dtype, True)
-             for n, g in zip(agg.group_names, final_groups)] +
-            [T.Field(n, a.dtype, True)
-             for n, a in zip(final_names, final_aggs)])
+                final_names.append(f"f{j}")
+        else:
+            fn = E.AggFunc.SUM if kind == "sum0" else a.func
+            fa = E.Aggregate(func=fn, arg=_col(pi_, a.dtype))
+            fa.dtype = a.dtype
+            final_aggs.append(fa)
+            final_names.append(f"f{pi_}")
+    merge = L.Aggregate(input=merged, group_exprs=final_groups,
+                        group_names=list(agg.group_names),
+                        aggs=final_aggs, agg_names=final_names)
+    merge.schema = T.Schema(
+        [T.Field(n, g.dtype, True)
+         for n, g in zip(agg.group_names, final_groups)] +
+        [T.Field(n, a.dtype, True)
+         for n, a in zip(final_names, final_aggs)])
 
-        # project back to the aggregate's declared output (AVG division,
-        # COUNT null->0 on empty-side sums)
-        out_exprs: list[E.Expr] = [
-            _col(i, g.dtype, agg.group_names[i])
-            for i, g in enumerate(agg.group_exprs)]
-        fi = k
-        for kind, _pi, a in final_plan:
-            if kind == "avg":
-                s = _col(fi, T.FLOAT64)
-                c = _col(fi + 1, T.INT64)
-                zero = E.Literal(value=0)
-                zero.dtype = T.INT64
-                cast = E.Cast(operand=c, to=T.FLOAT64)
-                cast.dtype = T.FLOAT64
-                div = E.Binary(op=E.BinOp.DIV, left=s, right=cast)
-                div.dtype = T.FLOAT64
-                isz = E.Binary(op=E.BinOp.EQ, left=c, right=zero)
-                isz.dtype = T.BOOL
-                nul = E.Literal(value=None, literal_type=T.FLOAT64)
-                nul.dtype = T.FLOAT64
-                case = E.Case(whens=[(isz, nul)], else_=div)
-                case.dtype = T.FLOAT64
-                out_exprs.append(case)
-                fi += 2
-            elif kind == "sum0":
-                s = _col(fi, T.INT64)
-                zero = E.Literal(value=0)
-                zero.dtype = T.INT64
-                isn = E.IsNull(operand=s)
-                isn.dtype = T.BOOL
-                case = E.Case(whens=[(isn, zero)], else_=s)
-                case.dtype = T.INT64
-                out_exprs.append(case)
-                fi += 1
-            else:
-                out_exprs.append(_col(fi, a.dtype))
-                fi += 1
-        proj = L.Project(input=merge, exprs=out_exprs,
-                         names=list(agg.schema.names))
-        proj.schema = agg.schema
-        return proj
+    # project back to the aggregate's declared output (AVG division,
+    # COUNT null->0 on empty-side sums)
+    out_exprs: list[E.Expr] = [
+        _col(i, g.dtype, agg.group_names[i])
+        for i, g in enumerate(agg.group_exprs)]
+    fi = k
+    for kind, _pi, a in final_plan:
+        if kind == "avg":
+            s = _col(fi, T.FLOAT64)
+            c = _col(fi + 1, T.INT64)
+            zero = E.Literal(value=0)
+            zero.dtype = T.INT64
+            cast = E.Cast(operand=c, to=T.FLOAT64)
+            cast.dtype = T.FLOAT64
+            div = E.Binary(op=E.BinOp.DIV, left=s, right=cast)
+            div.dtype = T.FLOAT64
+            isz = E.Binary(op=E.BinOp.EQ, left=c, right=zero)
+            isz.dtype = T.BOOL
+            nul = E.Literal(value=None, literal_type=T.FLOAT64)
+            nul.dtype = T.FLOAT64
+            case = E.Case(whens=[(isz, nul)], else_=div)
+            case.dtype = T.FLOAT64
+            out_exprs.append(case)
+            fi += 2
+        elif kind == "sum0":
+            s = _col(fi, T.INT64)
+            zero = E.Literal(value=0)
+            zero.dtype = T.INT64
+            isn = E.IsNull(operand=s)
+            isn.dtype = T.BOOL
+            case = E.Case(whens=[(isn, zero)], else_=s)
+            case.dtype = T.INT64
+            out_exprs.append(case)
+            fi += 1
+        else:
+            out_exprs.append(_col(fi, a.dtype))
+            fi += 1
+    proj = L.Project(input=merge, exprs=out_exprs,
+                     names=list(agg.schema.names))
+    proj.schema = agg.schema
+    return proj
 
 
 def _frag_refs(plan_json: dict) -> list[dict]:

@@ -85,6 +85,10 @@ class Connection:
         pkt = struct.pack("!ii", 8 + len(params), PROTOCOL_V3) + params
         self._sock.sendall(pkt)
         self._auth(password)
+        # the connect timeout must not become a permanent read deadline: a
+        # remote query legitimately taking longer would raise socket.timeout
+        # mid-conversation (blocking mode matches psycopg2's default)
+        self._sock.settimeout(None)
 
     # --- wire plumbing ---
 
@@ -203,9 +207,18 @@ class Connection:
 
 
 def connect(dsn: str = "", **kw) -> Connection:
-    """DSN form: 'host=... port=... user=... dbname=... password=...'."""
+    """DSN form: 'host=... port=... user=... dbname=... password=...'.
+    URI DSNs ('postgresql://...') are not parsed here — reject loudly rather
+    than silently connecting to defaults."""
+    if "://" in dsn:
+        raise PgWireError(
+            "URI-style DSNs are not supported by the bundled pgwire driver; "
+            "use 'host=... port=... user=... dbname=...' (or install "
+            "psycopg2 for URI support)")
     params: dict = {}
     for part in dsn.split():
+        if "=" not in part:
+            raise PgWireError(f"malformed DSN fragment {part!r}")
         k, _, v = part.partition("=")
         params[k] = v
     params.update(kw)

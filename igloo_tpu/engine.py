@@ -202,20 +202,32 @@ class QueryEngine:
             if hit is not None:
                 return (hit, plan) if want_plan else hit
         # a resolved multi-chip mesh takes precedence over single-device
-        # chunking: the sharded executor already bounds per-chip memory by
-        # row-sharding, and silently chunking would discard the parallelism
-        chunks = 0 if self._resolve_mesh() is not None else \
+        # chunking/out-of-core: the sharded executor already bounds per-chip
+        # memory by row-sharding, and silently chunking would discard the
+        # parallelism
+        mesh = self._resolve_mesh()
+        chunks = 0 if mesh is not None else \
             chunk_count(plan, self.chunk_budget_bytes)
-        if chunks:
-            tracing.counter("engine.chunked_route")
-            ex = LocalChunkExecutor(self.catalog, self._jit_cache,
-                                    use_jit=self._use_jit,
-                                    batch_cache=self.batch_cache,
-                                    chunks=chunks)
-        else:
-            ex = self._executor()
+        grace_found = None
+        if mesh is None and not chunks:
+            from igloo_tpu.exec.grace import find_grace_join
+            grace_found = find_grace_join(plan, self.chunk_budget_bytes)
         with span("execute"):
-            table = ex.execute_to_arrow(plan)
+            if chunks:
+                tracing.counter("engine.chunked_route")
+                table = LocalChunkExecutor(
+                    self.catalog, self._jit_cache, use_jit=self._use_jit,
+                    batch_cache=self.batch_cache,
+                    chunks=chunks).execute_to_arrow(plan)
+            elif grace_found:
+                from igloo_tpu.exec.grace import GraceJoinExecutor
+                tracing.counter("engine.grace_route")
+                table = GraceJoinExecutor(
+                    self.catalog, self._jit_cache, use_jit=self._use_jit,
+                    batch_cache=self.batch_cache,
+                    hints=self.hint_store).execute_to_arrow(plan, grace_found)
+            else:
+                table = self._executor().execute_to_arrow(plan)
         if rkey is not None:
             self.result_cache.put(rkey, table)
         if want_plan:

@@ -1,0 +1,248 @@
+"""Out-of-core GRACE hash join: single-device execution of joins whose inputs
+exceed the device-memory budget.
+
+Round-3 verdict item 4: the chunked executor only streams decomposable
+aggregates over scans (exec/chunked.py's documented ceiling) — a join over an
+over-budget table unions every chunk back into one device batch. This module
+lifts that ceiling the classic way, adapted to the static-shape TPU engine:
+
+  phase 1 (partition): each side of the join is read PROVIDER-PARTITION at a
+      time through the normal (fused) executor — projections/filters applied
+      on device, so only surviving columns/rows come back — and the resulting
+      host Arrow rows split into P buckets by a hash of the join key(s).
+      No full table ever materializes on device; host buffers hold only the
+      filtered, projected columns.
+  phase 2 (join): for p in 0..P, the p-th buckets of both sides register as
+      in-memory tables and the join subtree executes on device — equal keys
+      share a bucket, so the union over p IS the join. One partition pair on
+      device at a time bounds HBM by ~(input bytes / P).
+  merge: a decomposable Aggregate above the join runs as per-partition
+      PARTIALS (cluster/fragment.py's decomposition, shared with the
+      distributed planner); the final merge + everything above (sort/limit)
+      executes once over the concatenated partials. Without an aggregate the
+      per-partition join results concatenate host-side and the upper plan
+      runs over the union.
+
+Supported shape (v1): [Limit] [Sort] [Project]* [Aggregate(decomposable)]
+[Project/Filter]* Join(INNER equi). Anything else falls back to the normal
+path unchanged. The reference has no out-of-core story at all (its operators
+materialize build sides in RAM HashMaps, crates/engine/src/operators/
+hash_join.rs:100-128)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from igloo_tpu import types as T
+from igloo_tpu.plan import expr as E
+from igloo_tpu.plan import logical as L
+from igloo_tpu.sql.ast import JoinType
+from igloo_tpu.utils import tracing
+
+MAX_GRACE_PARTITIONS = 64
+
+
+def find_grace_join(plan: L.LogicalPlan, budget_bytes: int):
+    """Locate the supported-shape over-budget join. Returns
+    (path, agg, join, n_partitions) where `path` is the node chain from root
+    down to (excluding) the join, and `agg` the decomposable Aggregate on the
+    path (or None); None when the plan doesn't qualify."""
+    from igloo_tpu.cluster.fragment import _DECOMPOSABLE
+    from igloo_tpu.exec.chunked import estimated_bytes
+    path: list[L.LogicalPlan] = []
+    node = plan
+    agg: Optional[L.Aggregate] = None
+    while True:
+        if isinstance(node, (L.Limit, L.Sort, L.Project, L.Filter)):
+            path.append(node)
+            node = node.input
+        elif isinstance(node, L.Aggregate) and agg is None and \
+                not any(a.distinct for a in node.aggs) and \
+                all(a.func in _DECOMPOSABLE for a in node.aggs):
+            agg = node
+            path.append(node)
+            node = node.input
+        else:
+            break
+    if not (isinstance(node, L.Join) and node.join_type is JoinType.INNER
+            and node.left_keys):
+        return None
+    # all equi keys must be BARE COLUMNS hashable host-side (ints/dates);
+    # expression keys and strings (cross-side dictionary alignment) fall back
+    for key in node.left_keys + node.right_keys:
+        if not isinstance(key, E.Column) or key.index is None:
+            return None
+        if key.dtype is None or not (key.dtype.is_integer
+                                     or key.dtype.id == T.TypeId.DATE32):
+            return None
+    total = 0
+    over = False
+    for sc in L.walk_plan(node):
+        if isinstance(sc, L.Scan) and sc.provider is not None:
+            b = estimated_bytes(sc.provider)
+            if b is not None:
+                total += b
+                if b > budget_bytes:
+                    over = True
+    if not over:
+        return None
+    parts = min(MAX_GRACE_PARTITIONS, max(2, -(-total // budget_bytes)))
+    return path, agg, node, parts
+
+
+class GraceJoinExecutor:
+    """Executes a qualifying plan partition-pair at a time (see module doc)."""
+
+    def __init__(self, catalog, jit_cache=None, use_jit: bool = True,
+                 batch_cache=None, hints=None):
+        self.catalog = catalog
+        self._jit_cache = jit_cache if jit_cache is not None else {}
+        self._use_jit = use_jit
+        self._batch_cache = batch_cache
+        self._hints = hints
+
+    def _executor(self):
+        from igloo_tpu.exec.executor import Executor
+        return Executor(self._jit_cache, use_jit=self._use_jit,
+                        batch_cache=self._batch_cache, hints=self._hints)
+
+    def execute_to_arrow(self, plan: L.LogicalPlan, found) -> pa.Table:
+        from igloo_tpu.catalog import MemTable
+        from igloo_tpu.cluster.fragment import (
+            decompose_aggregate, final_merge_plan, partial_aggregate_node,
+        )
+        path, agg, join, n_parts = found
+        tracing.counter("grace.join")
+
+        lparts = self._partition_side(join.left, join.left_keys, n_parts)
+        rparts = self._partition_side(join.right, join.right_keys, n_parts)
+
+        # per-partition plan: the join with its sides replaced by scans of
+        # the partition tables, plus the path segment BELOW the aggregate
+        below: list[L.LogicalPlan] = []
+        if agg is not None:
+            i = path.index(agg)
+            below = path[i + 1:]
+            partial_schema, partial_aggs, partial_names, final_spec = \
+                decompose_aggregate(agg)
+
+        partials: list[pa.Table] = []
+        for p in range(n_parts):
+            lt, rt = lparts[p], rparts[p]
+            if lt.num_rows == 0 or rt.num_rows == 0:
+                continue  # inner join: an empty side contributes nothing
+            sub = self._rebuild_join(join, lt, rt)
+            for node in reversed(below):
+                sub = _rewire(node, sub)
+            if agg is not None:
+                sub = partial_aggregate_node(agg, sub, partial_schema,
+                                             partial_aggs, partial_names)
+            partials.append(self._executor().execute_to_arrow(sub))
+
+        if agg is not None:
+            if partials:
+                merged_tbl = pa.concat_tables(partials)
+            else:
+                merged_tbl = partial_schema_empty(partial_schema)
+            merged_scan = _mem_scan("__grace_partials", MemTable(merged_tbl),
+                                    partial_schema)
+            top = final_merge_plan(agg, merged_scan, final_spec)
+            upper = path[: path.index(agg)]
+        else:
+            out_tbl = pa.concat_tables(partials) if partials else \
+                partial_schema_empty(join.schema)
+            top = _mem_scan("__grace_joined", MemTable(out_tbl), join.schema)
+            upper = path
+        for node in reversed(upper):
+            top = _rewire(node, top)
+        return self._executor().execute_to_arrow(top)
+
+    # --- phase 1 ---
+
+    def _partition_side(self, side: L.LogicalPlan, keys: list[E.Expr],
+                        n_parts: int) -> list[pa.Table]:
+        """Read the side provider-partition at a time through the device
+        executor, hash its join keys host-side, split rows into buckets."""
+        sc = next((n for n in L.walk_plan(side) if isinstance(n, L.Scan)), None)
+        chunks: list[tuple] = [(None,)]
+        if sc is not None and sc.provider is not None and sc.partition is None:
+            try:
+                np_ = sc.provider.num_partitions()
+            except Exception:
+                np_ = 1
+            if np_ > 1:
+                chunks = [(i,) for i in range(np_)]
+        buckets: list[list[pa.Table]] = [[] for _ in range(n_parts)]
+        key_names = [self._key_column_name(side, k) for k in keys]
+        for chunk in chunks:
+            sub = L.copy_plan(side)
+            if chunk != (None,):
+                sc2 = next(n for n in L.walk_plan(sub) if isinstance(n, L.Scan))
+                sc2.partition = chunk
+                tok = getattr(sc2.provider, "partition_token", None)
+                if tok is not None:
+                    try:
+                        sc2.partition_token = tok()
+                    except Exception:
+                        pass
+            tbl = self._executor().execute_to_arrow(sub)
+            if tbl.num_rows == 0:
+                continue
+            h = np.zeros(tbl.num_rows, dtype=np.uint64)
+            for name in key_names:
+                col = tbl.column(name).combine_chunks()
+                if pa.types.is_date32(col.type):
+                    col = col.cast(pa.int32())  # date32 -> int64 is not a
+                    # supported arrow cast; go through the day count
+                vals = np.asarray(col.cast(pa.int64()).fill_null(0)) \
+                    .astype(np.uint64)
+                h = h * np.uint64(0x9E3779B97F4A7C15) + vals
+                h ^= h >> np.uint64(29)
+            pid = (h % np.uint64(n_parts)).astype(np.int64)
+            for p in np.unique(pid):
+                buckets[int(p)].append(
+                    tbl.filter(pa.array(pid == p)))
+        out = []
+        for p in range(n_parts):
+            out.append(pa.concat_tables(buckets[p]) if buckets[p]
+                       else tbl_empty_like(side.schema))
+        return out
+
+    @staticmethod
+    def _key_column_name(side: L.LogicalPlan, key: E.Expr) -> str:
+        # find_grace_join admits only bare bound columns
+        return side.schema.fields[key.index].name
+
+    # --- plan surgery ---
+
+    @staticmethod
+    def _rebuild_join(join: L.Join, lt: pa.Table, rt: pa.Table) -> L.Join:
+        from igloo_tpu.catalog import MemTable
+        j = L.copy_plan(join)
+        j.left = _mem_scan("__grace_l", MemTable(lt), join.left.schema)
+        j.right = _mem_scan("__grace_r", MemTable(rt), join.right.schema)
+        return j
+
+
+def _mem_scan(name: str, provider, schema: T.Schema) -> L.Scan:
+    s = L.Scan(table=name, provider=provider)
+    s.schema = schema
+    return s
+
+
+def _rewire(node: L.LogicalPlan, new_input: L.LogicalPlan) -> L.LogicalPlan:
+    n = L.copy_plan(node)
+    n.input = new_input
+    return n
+
+
+def tbl_empty_like(schema: T.Schema) -> pa.Table:
+    from igloo_tpu.exec.batch import dtype_to_arrow
+    arrays = [pa.array([], type=dtype_to_arrow(f.dtype)) for f in schema]
+    return pa.Table.from_arrays(arrays, names=schema.names)
+
+
+def partial_schema_empty(schema: T.Schema) -> pa.Table:
+    return tbl_empty_like(schema)

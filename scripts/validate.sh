@@ -12,8 +12,15 @@ else
   echo "ruff not installed here; skipping lint (CI runs it)"
 fi
 
-echo "== pytest (full suite, virtual 8-device CPU mesh) =="
-python -m pytest tests/ -q
+echo "== pytest (fast tier, virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q -m "not slow"
+
+echo "== pytest (slow tier: shard_map / multi-process / out-of-core) =="
+if [ "${SKIP_SLOW:-0}" = "1" ]; then
+  echo "SKIP_SLOW=1: skipping (CI and the round driver still run everything)"
+else
+  python -m pytest tests/ -q -m slow
+fi
 
 echo "== graft entry (single-chip jit + 8-device dryrun) =="
 python __graft_entry__.py

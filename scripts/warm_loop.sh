@@ -1,0 +1,29 @@
+#!/bin/bash
+# Warm the TPU caches for the full TPC-H set with a stall watchdog: if the
+# warm-cache log stops advancing for STALL_S seconds (a pathological XLA
+# compile), kill and restart — the nofuse sentinel routes the hung program to
+# the staged path on the next attempt, so every restart makes progress.
+SF="${1:-1}"
+LOG="${2:-/tmp/warm_loop.log}"
+STALL_S="${STALL_S:-480}"
+for attempt in $(seq 1 8); do
+  echo "=== warm-cache attempt $attempt ===" >> "$LOG"
+  python -m igloo_tpu.cli --warm-cache "$SF" >> "$LOG" 2>&1 &
+  pid=$!
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 30
+    age=$(( $(date +%s) - $(stat -c %Y "$LOG") ))
+    if [ "$age" -gt "$STALL_S" ]; then
+      echo "=== stalled ${age}s; killing ===" >> "$LOG"
+      kill -9 "$pid" 2>/dev/null
+      wait "$pid" 2>/dev/null
+      break
+    fi
+  done
+  if wait "$pid" 2>/dev/null; then
+    echo "=== warm-cache complete ===" >> "$LOG"
+    exit 0
+  fi
+done
+echo "=== gave up after 8 attempts ===" >> "$LOG"
+exit 1

@@ -34,3 +34,10 @@ assert jax.default_backend() == "cpu", (
 import igloo_tpu.engine  # noqa: E402
 
 igloo_tpu.engine.DEFAULT_MESH = None
+
+# NOTE (round 4): a session-shared jit compile cache was tried here to cut
+# CPU compile time and REVERTED: keeping every compiled XLA:CPU executable
+# alive for the whole session reproducibly segfaulted the process in
+# libgcc's unwinder (dmesg: "segfault ... in libgcc_s.so.1") near the end of
+# the suite — and saved no wall-clock. Per-engine caches let executables be
+# garbage-collected between tests, which round 3 ran stably with.

@@ -13,6 +13,8 @@ from igloo_tpu.engine import QueryEngine
 from igloo_tpu.parallel.executor import ShardedExecutor
 from igloo_tpu.parallel.mesh import make_mesh
 
+pytestmark = pytest.mark.slow  # shard_map compiles dominate (~6 min)
+
 
 @pytest.fixture(scope="module")
 def mesh():
@@ -263,3 +265,13 @@ def test_sharded_distinct_hash_partitioned(engine, mesh):
     assert seen, "sharded distinct path did not run"
     for local_in, local_out in seen:
         assert local_out <= 2 * local_in, (local_in, local_out)
+
+
+def test_sharded_window_functions(engine, mesh):
+    # inherited single-program path over row-sharded inputs: GSPMD inserts
+    # the gathers; values must match the single-device engine exactly
+    check(engine, mesh, """
+        SELECT k, v, row_number() OVER (PARTITION BY k ORDER BY v) AS rn,
+               sum(v) OVER (PARTITION BY k) AS s
+        FROM t ORDER BY k, v
+    """)
