@@ -12,26 +12,28 @@ where `value` is the geometric-mean warm throughput over all 22 TPC-H queries
 (rows of the dominant scanned table / MEDIAN warm wall-clock) on the default
 JAX device (one TPU chip under the driver), and `vs_baseline` is the ratio of
 that throughput to single-threaded pandas executing the same queries over the
-same in-memory data (>1.0 = faster than the pandas CPU baseline). Both sides
-report median-of-N trials with min/max spread (round-3 verdict: single-trial
-numbers were noise-limited).
+same data (>1.0 = faster than the pandas CPU baseline). Both sides report
+median-of-N trials with min/max spread (round-3 verdict: single-trial numbers
+were noise-limited).
+
+Each query runs in its OWN subprocess (igloo_tpu/bench/runner.py) under a hard
+timeout, so one pathological XLA compile cannot hang the whole benchmark —
+it is recorded as an error and the sweep continues. Tables are generated once
+and staged to parquet; the persistent XLA compile cache and cardinality-hint
+store (`.xla_cache/`) make subprocess cold starts warm after the first-ever
+sweep (`igloo-cli --warm-cache` pre-warms).
 
 The reference publishes no numbers (BASELINE.md: roadmap TODO only) and its
 DataFusion CPU path cannot be installed here (no package egress), so the
 baseline is measured pandas, per BASELINE.md's "measured, not copied" plan.
 
 Env knobs:
-    BENCH_SF       scale factor for the main block (default 1)
-    BENCH_QUERIES  csv of query ids (default: all 22)
-    BENCH_TRIALS   warm trials per query, median reported (default 5)
-    BENCH_SF10     "1" to append the SF10 Q3/Q5 block (default 1; set 0 to
-                   skip — it generates a 60M-row lineitem)
-    BENCH_SF10_QUERIES  csv for the SF10 block (default q3,q5)
-
-Cold times include XLA compilation on the first process; the persistent
-compile cache (IGLOO_TPU_COMPILE_CACHE) plus the on-disk cardinality-hint
-store make later processes start warm. `igloo-cli warm-cache` precompiles the
-full TPC-H stage set.
+    BENCH_SF             scale factor for the main block (default 1)
+    BENCH_QUERIES        csv of query ids (default: all 22)
+    BENCH_TRIALS         warm trials per query, median reported (default 5)
+    BENCH_QUERY_TIMEOUT  per-query subprocess timeout seconds (default 1800)
+    BENCH_SF10           "1" to append the SF10 Q3/Q5 block (default 1)
+    BENCH_SF10_QUERIES   csv for the SF10 block (default q3,q5)
 """
 from __future__ import annotations
 
@@ -39,6 +41,7 @@ import json
 import math
 import os
 import statistics
+import subprocess
 import sys
 import time
 
@@ -47,99 +50,78 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _to_pandas(tables):
-    """Arrow -> pandas with date32 columns as int days (cheap comparisons for
-    the baseline; the cutoffs in tpch_pandas use the same representation)."""
-    import numpy as np
-    out = {}
-    for name, tbl in tables.items():
-        import pyarrow as pa
-        cols = {}
-        for field, col in zip(tbl.schema, tbl.columns):
-            if pa.types.is_date32(field.type):
-                cols[field.name] = col.cast(pa.int32()).to_numpy()
-            else:
-                cols[field.name] = col.to_pandas()
-        import pandas as pd
-        out[name] = pd.DataFrame(cols)
-    return out
-
-
-def _trials(fn, n: int, pre=None):
-    times = []
-    for _ in range(n):
-        if pre is not None:
-            pre()
-        t0 = time.perf_counter()
-        fn()
-        times.append(time.perf_counter() - t0)
-    return times
-
-
 def _spread(times):
     return (round(statistics.median(times), 4),
             round(min(times), 4), round(max(times), 4))
 
 
-def bench_block(sf: float, queries: list[str], trials: int,
-                pandas_too: bool = True) -> tuple[dict, list, list]:
-    from igloo_tpu.bench.tpch import QUERIES, gen_tables, register_all
+def _pandas_tables(stage: str):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    out = {}
+    for name in ("region", "nation", "supplier", "part", "partsupp",
+                 "customer", "orders", "lineitem"):
+        tbl = pq.read_table(os.path.join(stage, f"{name}.parquet"))
+        cols = {}
+        import pandas as pd
+        for field, col in zip(tbl.schema, tbl.columns):
+            if pa.types.is_date32(field.type):
+                cols[field.name] = col.cast(pa.int32()).to_numpy()
+            else:
+                cols[field.name] = col.to_pandas()
+        out[name] = pd.DataFrame(cols)
+    return out
+
+
+def bench_block(sf: float, queries: list[str], trials: int) -> tuple:
+    from igloo_tpu.bench.runner import ensure_staged
     from igloo_tpu.bench.tpch_pandas import PANDAS_QUERIES
-    from igloo_tpu.engine import QueryEngine
 
-    t0 = time.perf_counter()
-    tables = gen_tables(sf=sf)
-    n_li = tables["lineitem"].num_rows
-    log(f"generated TPC-H sf={sf}: lineitem={n_li} rows "
-        f"({time.perf_counter() - t0:.1f}s)")
+    stage = ensure_staged(sf)
+    import pyarrow.parquet as pq
+    n_li = pq.read_metadata(os.path.join(stage, "lineitem.parquet")).num_rows
+    log(f"TPC-H sf={sf}: lineitem={n_li} rows (staged at {stage})")
 
-    engine = QueryEngine()
-    register_all(engine, tables)
-    pdt = _to_pandas(tables) if pandas_too else None
-
+    timeout = float(os.environ.get("BENCH_QUERY_TIMEOUT", "1800"))
     block = {"sf": sf, "lineitem_rows": n_li, "queries": {}}
     ours_tp, base_tp = [], []
+    pdt = None
     for q in queries:
-        sql = QUERIES[q]
+        cmd = [sys.executable, "-m", "igloo_tpu.bench.runner",
+               q, str(sf), stage, str(trials)]
         try:
             t0 = time.perf_counter()
-            engine.execute(sql)
-            cold = time.perf_counter() - t0
-            # adopt cardinality hints BEFORE timing: deep join chains settle
-            # over a couple of runs (hint adoption recompiles; a flipped
-            # direct-join side adds one exact re-run), so iterate until the
-            # run time stops collapsing
-            prev = cold
-            for _ in range(4):
-                engine.result_cache.clear()
-                t0 = time.perf_counter()
-                engine.execute(sql)
-                cur = time.perf_counter() - t0
-                if cur > 0.5 * prev:
-                    break
-                prev = cur
-            # warm = EXECUTION throughput: clear the result cache before each
-            # run (a repeated identical query would otherwise measure the ~ms
-            # result-cache hit, which pandas isn't given either)
-            warm = _trials(lambda: engine.execute(sql), trials,
-                           pre=engine.result_cache.clear)
-            t0 = time.perf_counter()
-            engine.execute(sql)
-            cached = time.perf_counter() - t0  # result-cache hit latency
-        except Exception as e:  # record the failure, keep benching
-            log(f"{q}: FAILED {type(e).__name__}: {e}")
-            block["queries"][q] = {"error": f"{type(e).__name__}: {e}"}
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout, cwd=os.path.dirname(
+                                      os.path.abspath(__file__)))
+            took = time.perf_counter() - t0
+        except subprocess.TimeoutExpired:
+            log(f"{q}: TIMEOUT after {timeout:.0f}s (recorded, continuing)")
+            block["queries"][q] = {"error": f"timeout after {timeout:.0f}s"}
             continue
-        med, lo, hi = _spread(warm)
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode != 0 or line is None:
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            log(f"{q}: FAILED rc={proc.returncode}: {' | '.join(tail)}")
+            block["queries"][q] = {"error": f"rc={proc.returncode}"}
+            continue
+        r = json.loads(line)
+        med, lo, hi = _spread(r["warm_trials"])
         rps = n_li / med
-        rec = {"cold_s": round(cold, 4), "warm_med_s": med,
-               "warm_min_s": lo, "warm_max_s": hi,
-               "cached_s": round(cached, 4), "rows_per_s": round(rps)}
-        if pandas_too and q in PANDAS_QUERIES:
+        rec = {"cold_s": r["cold_s"], "warm_med_s": med, "warm_min_s": lo,
+               "warm_max_s": hi, "cached_s": r["cached_s"],
+               "rows_per_s": round(rps), "proc_s": round(took, 1)}
+        if q in PANDAS_QUERIES:
+            if pdt is None:
+                pdt = _pandas_tables(stage)
             try:
-                pd_times = _trials(lambda: PANDAS_QUERIES[q](pdt),
-                                   max(trials, 3))
-                pmed, plo, phi = _spread(pd_times)
+                times = []
+                for _ in range(max(trials, 3)):
+                    t0 = time.perf_counter()
+                    PANDAS_QUERIES[q](pdt)
+                    times.append(time.perf_counter() - t0)
+                pmed, plo, phi = _spread(times)
                 rec.update(pandas_med_s=pmed, pandas_min_s=plo,
                            pandas_max_s=phi,
                            vs_pandas=round(pmed / med, 3))
@@ -148,7 +130,7 @@ def bench_block(sf: float, queries: list[str], trials: int,
             except Exception as e:
                 log(f"{q}: pandas baseline FAILED {type(e).__name__}: {e}")
         block["queries"][q] = rec
-        log(f"{q}: cold={cold:.2f}s warm={med:.4f}s [{lo:.4f},{hi:.4f}] "
+        log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s [{lo:.4f},{hi:.4f}] "
             f"({rps:,.0f} rows/s) pandas={rec.get('pandas_med_s', '-')}s "
             f"vs_pandas={rec.get('vs_pandas', '-')}")
     return block, ours_tp, base_tp

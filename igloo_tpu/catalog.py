@@ -42,6 +42,10 @@ class MemTable:
     """In-memory table over a pyarrow Table (reference uses DataFusion MemTable for
     the CLI's sample `users` table, crates/igloo/src/main.rs:59-77)."""
 
+    def __deepcopy__(self, memo):
+        # providers are shared by plan/expression copies (see copy_plan)
+        return self
+
     def __init__(self, table: pa.Table, partitions: int = 1):
         self._table = table
         self._schema = schema_from_arrow(table.schema)

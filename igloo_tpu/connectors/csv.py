@@ -21,6 +21,10 @@ from igloo_tpu.types import Schema
 
 
 class CsvTable:
+    def __deepcopy__(self, memo):
+        # providers are shared by plan/expression copies (see copy_plan)
+        return self
+
     def __init__(self, path: str, has_header: bool = True,
                  delimiter: str = ","):
         self.path = path

@@ -35,6 +35,10 @@ _CONTENT_DATA = 0
 
 
 class IcebergTable:
+    def __deepcopy__(self, memo):
+        # providers are shared by plan/expression copies (see copy_plan)
+        return self
+
     def __init__(self, path: str, snapshot_id: Optional[int] = None):
         self.path = path.rstrip("/")
         self.snapshot_id = snapshot_id

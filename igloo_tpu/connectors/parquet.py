@@ -43,6 +43,13 @@ class ParquetTable:
     def schema(self) -> Schema:
         return self._schema
 
+    def __deepcopy__(self, memo):
+        # providers are SHARED by plan copies (plan/logical.copy_plan shares
+        # them deliberately); expression deepcopies that reach a provider
+        # through a bound subquery plan must not clone it — the partition
+        # lock isn't picklable and cloning would fork cache identity
+        return self
+
     def snapshot(self):
         """Cache/CDC token: changes when any underlying file changes on disk
         (re-globs directory/glob paths so added files are seen — and drops the

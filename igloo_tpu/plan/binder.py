@@ -700,10 +700,40 @@ class Binder:
                        left_keys=[probe] + corr_l, right_keys=[key_r] + corr_r)
             j.schema = plan.schema
             return j
-        # NOT IN: anti join on the CORRELATION keys only, with the IN condition
-        # as a residual that is satisfied when the pair is "not definitely
-        # unequal": probe = y OR y IS NULL OR probe IS NULL. This encodes SQL
-        # three-valued NOT IN exactly, per correlation group:
+        if not corr_l:
+            # UNCORRELATED NOT IN: a keyed anti join + two scalar guards.
+            # The residual form below matches every |left| x |sub| pair (its
+            # join has no keys), whose candidate expansion is |L|x|S| slots —
+            # at TPC-H SF1 q16 that is an ~3e8-lane program the TPU compiler
+            # cannot hold. Keyed anti gives "no equal y" directly; SQL
+            # three-valued NOT IN then needs exactly two data-dependent
+            # corrections, both one-row scalars evaluated once:
+            #   S contains a NULL  -> NOT IN is never TRUE -> keep nothing
+            #   probe IS NULL      -> dropped unless S is empty
+            j = L.Join(left=plan, right=sub, join_type=A.JoinType.ANTI,
+                       left_keys=[probe], right_keys=[key_r])
+            j.schema = plan.schema
+            c_null = self._count_scalar(sub, null_key_only=True)
+            c_all = self._count_scalar(sub, null_key_only=False)
+            zero = E.Literal(value=0, literal_type=T.INT64)
+            zero.dtype = T.INT64
+            no_nulls = E.Binary(op=E.BinOp.EQ, left=c_null, right=zero)
+            no_nulls.dtype = T.BOOL
+            x_not_null = E.IsNull(operand=copy.deepcopy(probe), negated=True)
+            x_not_null.dtype = T.BOOL
+            zero2 = E.Literal(value=0, literal_type=T.INT64)
+            zero2.dtype = T.INT64
+            s_empty = E.Binary(op=E.BinOp.EQ, left=c_all, right=zero2)
+            s_empty.dtype = T.BOOL
+            x_ok = E.Binary(op=E.BinOp.OR, left=x_not_null, right=s_empty)
+            x_ok.dtype = T.BOOL
+            keep = E.Binary(op=E.BinOp.AND, left=no_nulls, right=x_ok)
+            keep.dtype = T.BOOL
+            return self._filter(j, keep)
+        # correlated NOT IN: anti join on the CORRELATION keys only, with the
+        # IN condition as a residual that is satisfied when the pair is "not
+        # definitely unequal": probe = y OR y IS NULL OR probe IS NULL. This
+        # encodes SQL three-valued NOT IN exactly, per correlation group:
         #   empty group            -> no candidate -> row kept
         #   group contains NULL y  -> residual true -> row dropped
         #   probe NULL, group != {} -> residual true -> row dropped
@@ -721,6 +751,26 @@ class Binder:
                    left_keys=corr_l, right_keys=corr_r, residual=residual)
         j.schema = plan.schema
         return j
+
+    def _count_scalar(self, sub: L.LogicalPlan,
+                      null_key_only: bool) -> E.ScalarSubquery:
+        """Bound scalar subquery `(SELECT count(*) FROM sub [WHERE key IS
+        NULL])` over a copy of a one-column subquery plan."""
+        s = L.copy_plan(sub)
+        if null_key_only:
+            c = E.Column(s.schema.fields[0].name, index=0)
+            c.dtype = s.schema.fields[0].dtype
+            cond = E.IsNull(operand=c)
+            cond.dtype = T.BOOL
+            s = self._filter(s, cond)
+        a = E.Aggregate(func=E.AggFunc.COUNT_STAR)
+        a.dtype = T.INT64
+        node = L.Aggregate(input=s, group_exprs=[], group_names=[],
+                           aggs=[a], agg_names=["__c"])
+        node.schema = T.Schema([T.Field("__c", T.INT64, True)])
+        q = E.ScalarSubquery(query=node)
+        q.dtype = T.INT64
+        return q
 
     def _rewrite_exists(self, node: E.Exists, plan, scope, anti: bool):
         sub = self.bind_query(node.query, scope)

@@ -29,11 +29,49 @@ from igloo_tpu.sql.ast import JoinType
 
 
 def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
+    _optimize_subqueries(plan)
     plan = fold_constants_pass(plan)
     plan = reorder_cross_joins(plan)
     plan = pushdown_filters(plan)
     plan = prune_projections(plan)
     return plan
+
+
+def _node_exprs(node: L.LogicalPlan) -> list:
+    if isinstance(node, L.Filter):
+        return [node.predicate]
+    if isinstance(node, L.Project):
+        return list(node.exprs)
+    if isinstance(node, L.Aggregate):
+        return list(node.group_exprs) + [a.arg for a in node.aggs
+                                         if a.arg is not None]
+    if isinstance(node, L.Join):
+        out = list(node.left_keys) + list(node.right_keys)
+        if node.residual is not None:
+            out.append(node.residual)
+        return out
+    if isinstance(node, L.Sort):
+        return list(node.keys)
+    if isinstance(node, L.Window):
+        return (list(node.partition_exprs) + list(node.order_exprs)
+                + list(node.funcs))
+    if isinstance(node, L.Scan):
+        return list(node.pushed_filters)
+    return []
+
+
+def _optimize_subqueries(plan: L.LogicalPlan) -> None:
+    """Run the FULL pass pipeline over every bound scalar-subquery plan.
+    Without this, subquery joins stay in their raw bound shape — Filters over
+    CROSS joins — which the executor expands as a full cross product (TPC-H
+    Q11's HAVING subquery: |partsupp| x |supplier| = 8e9 candidate slots at
+    SF1). Recursion through optimize() also covers nested subqueries."""
+    for node in L.walk_plan(plan):
+        for e in _node_exprs(node):
+            for n in E.walk(e):
+                if isinstance(n, E.ScalarSubquery) and \
+                        isinstance(n.query, L.LogicalPlan):
+                    n.query = optimize(n.query)
 
 
 # --- join reorder (cross-product avoidance) ---------------------------------------

@@ -6,7 +6,7 @@
 SF="${1:-1}"
 LOG="${2:-/tmp/warm_loop.log}"
 STALL_S="${STALL_S:-480}"
-for attempt in $(seq 1 8); do
+for attempt in $(seq 1 "${MAX_ATTEMPTS:-20}"); do
   echo "=== warm-cache attempt $attempt ===" >> "$LOG"
   python -m igloo_tpu.cli --warm-cache "$SF" >> "$LOG" 2>&1 &
   pid=$!
@@ -25,5 +25,5 @@ for attempt in $(seq 1 8); do
     exit 0
   fi
 done
-echo "=== gave up after 8 attempts ===" >> "$LOG"
+echo "=== gave up after ${MAX_ATTEMPTS:-20} attempts ===" >> "$LOG"
 exit 1

@@ -125,7 +125,12 @@ def test_in_subquery_becomes_semi_join(catalog):
     assert isinstance(join, L.Join)
     assert join.join_type.value == "semi"
     plan = bind(catalog, "SELECT a FROM t WHERE a NOT IN (SELECT a FROM u)")
-    assert plan.input.join_type.value == "anti"
+    # uncorrelated NOT IN: keyed anti join under the null-semantics guard
+    # filter (round 4 — the residual form expanded |L|x|S| candidate pairs)
+    guard = plan.input
+    assert isinstance(guard, L.Filter)
+    assert guard.input.join_type.value == "anti"
+    assert guard.input.left_keys and guard.input.right_keys
 
 
 def test_correlated_exists(catalog):

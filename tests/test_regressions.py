@@ -188,3 +188,32 @@ def test_sort_path_aggregate_inf_isolated():
                        "GROUP BY k ORDER BY k")
     assert got.column("s").to_pylist() == [float("inf"), 5.0, 4.0]
     assert got.column("c").to_pylist() == [2, 2, 1]
+
+
+def test_not_in_three_valued_null_semantics():
+    """Uncorrelated NOT IN (round-4 keyed-anti + scalar-guard rewrite) must
+    keep SQL's three-valued logic: NULL in the subquery empties the result,
+    a NULL probe row only survives when the subquery is empty."""
+    import pyarrow as pa
+
+    from igloo_tpu.engine import QueryEngine
+    e = QueryEngine()
+    e.register_table("t", pa.table({
+        "x": pa.array([1, 2, None, 4], type=pa.int64())}))
+    e.register_table("s_plain", pa.table({
+        "y": pa.array([2, 3], type=pa.int64())}))
+    e.register_table("s_null", pa.table({
+        "y": pa.array([2, None], type=pa.int64())}))
+    e.register_table("s_empty", pa.table({
+        "y": pa.array([], type=pa.int64())}))
+
+    q = "SELECT x FROM t WHERE x NOT IN (SELECT y FROM {}) ORDER BY x"
+    # plain: matches drop, NULL probe drops (comparison is NULL)
+    assert e.execute(q.format("s_plain")).to_pydict() == {"x": [1, 4]}
+    # NULL in the subquery: nothing is ever definitely NOT IN
+    assert e.execute(q.format("s_null")).to_pydict() == {"x": []}
+    # empty subquery: vacuous truth — every row INCLUDING the NULL survives
+    got = e.execute("SELECT x FROM t WHERE x NOT IN (SELECT y FROM s_empty)"
+                    ).to_pydict()["x"]
+    assert sorted(v for v in got if v is not None) == [1, 2, 4]
+    assert None in got
