@@ -8,20 +8,26 @@ Prints per-query detail lines to stderr and EXACTLY ONE JSON line to stdout:
     {"metric": "tpch_warm_rows_per_s", "value": N, "unit": "rows/s/chip",
      "vs_baseline": R, "detail": {...}}
 
-where `value` is the geometric-mean warm throughput over all 22 TPC-H queries
-(rows of the dominant scanned table / MEDIAN warm wall-clock) on the default
-JAX device (one TPU chip under the driver), and `vs_baseline` is the ratio of
-that throughput to single-threaded pandas executing the same queries over the
-same data (>1.0 = faster than the pandas CPU baseline). Both sides report
-median-of-N trials with min/max spread (round-3 verdict: single-trial numbers
-were noise-limited).
+where `value` is the geometric-mean warm throughput over the TPC-H queries
+(rows of lineitem / MEDIAN warm wall-clock) on the default JAX device (one TPU
+chip under the driver), and `vs_baseline` is the ratio of that throughput to
+single-threaded pandas executing the same queries over the same data (>1.0 =
+faster than the pandas CPU baseline).
 
-Each query runs in its OWN subprocess (igloo_tpu/bench/runner.py) under a hard
-timeout, so one pathological XLA compile cannot hang the whole benchmark —
-it is recorded as an error and the sweep continues. Tables are generated once
-and staged to parquet; the persistent XLA compile cache and cardinality-hint
-store (`.xla_cache/`) make subprocess cold starts warm after the first-ever
-sweep (`igloo-cli --warm-cache` pre-warms).
+Architecture (round-5 redesign, VERDICT.md "next round" #1-2):
+
+- ONE sweep worker subprocess runs ALL queries (igloo_tpu/bench/sweep.py):
+  the tables upload through the ~10-20 MB/s tunnel ONCE (column-granular HBM
+  scan cache) instead of once per query — round 4's per-query subprocesses
+  spent their "cold compile" seconds mostly re-uploading data.
+- This orchestrator enforces a GLOBAL deadline (BENCH_DEADLINE_S, default
+  19 min) and a per-query stall timeout (BENCH_STALL_S): a pathological XLA
+  compile gets its worker killed, the query is poisoned, and a fresh worker
+  resumes with the remaining queries. Whatever has completed when the deadline
+  hits is emitted — this process ALWAYS prints its JSON line.
+- pandas baselines run in THIS process between worker status reads (the TPU
+  and the CPU work overlap).
+- The SF10 block runs only if the remaining budget fits its estimated cost.
 
 The reference publishes no numbers (BASELINE.md: roadmap TODO only) and its
 DataFusion CPU path cannot be installed here (no package egress), so the
@@ -31,7 +37,8 @@ Env knobs:
     BENCH_SF             scale factor for the main block (default 1)
     BENCH_QUERIES        csv of query ids (default: all 22)
     BENCH_TRIALS         warm trials per query, median reported (default 5)
-    BENCH_QUERY_TIMEOUT  per-query subprocess timeout seconds (default 1800)
+    BENCH_DEADLINE_S     global wall-clock budget in seconds (default 1140)
+    BENCH_STALL_S        kill a worker silent for this long (default 300)
     BENCH_SF10           "1" to append the SF10 Q3/Q5 block (default 1)
     BENCH_SF10_QUERIES   csv for the SF10 block (default q3,q5)
 """
@@ -40,14 +47,24 @@ from __future__ import annotations
 import json
 import math
 import os
+import selectors
 import statistics
 import subprocess
 import sys
 import time
 
+T_START = time.time()
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1140"))
+STALL_S = float(os.environ.get("BENCH_STALL_S", "300"))
+REPO = os.path.dirname(os.path.abspath(__file__))
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def remaining() -> float:
+    return DEADLINE_S - (time.time() - T_START)
 
 
 def _spread(times):
@@ -56,6 +73,7 @@ def _spread(times):
 
 
 def _pandas_tables(stage: str):
+    import pandas as pd
     import pyarrow as pa
     import pyarrow.parquet as pq
     out = {}
@@ -63,7 +81,6 @@ def _pandas_tables(stage: str):
                  "customer", "orders", "lineitem"):
         tbl = pq.read_table(os.path.join(stage, f"{name}.parquet"))
         cols = {}
-        import pandas as pd
         for field, col in zip(tbl.schema, tbl.columns):
             if pa.types.is_date32(field.type):
                 cols[field.name] = col.cast(pa.int32()).to_numpy()
@@ -73,66 +90,169 @@ def _pandas_tables(stage: str):
     return out
 
 
-def bench_block(sf: float, queries: list[str], trials: int) -> tuple:
+class SweepDriver:
+    """Runs sweep workers under the stall watchdog; restarts past poisoned
+    queries; yields per-query result records."""
+
+    def __init__(self, stage: str, queries: list, trials: int):
+        self.stage = stage
+        self.queries = queries
+        self.trials = trials
+        self.poisoned: list[str] = []
+        self.results: dict[str, dict] = {}
+
+    def _spawn(self, queries: list):
+        cmd = [sys.executable, "-m", "igloo_tpu.bench.sweep",
+               "--stage", self.stage, "--queries", ",".join(queries),
+               "--trials", str(self.trials),
+               "--skip", ",".join(self.poisoned),
+               "--deadline", str(T_START + DEADLINE_S - 30)]
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE)
+        os.set_blocking(proc.stdout.fileno(), False)
+        os.set_blocking(proc.stderr.fileno(), False)
+        return proc
+
+    def _consume(self, tag: str, line: str, state: dict, on_result) -> None:
+        if tag == "err":
+            if line.startswith("SWEEP-START "):
+                state["current_q"] = line.split()[1]
+            log(f"[worker] {line}")
+            return
+        if not line.startswith("{"):
+            return
+        try:
+            rec = json.loads(line)
+            q = rec.pop("q")
+        except Exception:
+            log(f"bench: unparseable worker line: {line[:200]}")
+            return
+        self.results[q] = rec
+        if q in state["todo"]:
+            state["todo"].remove(q)
+        on_result(q, rec)
+
+    def run(self, on_result):
+        """Drives workers with non-blocking raw-fd reads + manual line
+        splitting: select() + buffered readline() can block on partial lines
+        and hide buffered lines from the poll, which would blind both the
+        stall watchdog and the stall attribution."""
+        todo = list(self.queries)
+        restarts = 0
+        while todo and remaining() > 45 and restarts < 4:
+            proc = self._spawn(todo)
+            state = {"current_q": None, "todo": todo}
+            last_activity = time.time()
+            sel = selectors.DefaultSelector()
+            streams = {proc.stdout.fileno(): ["out", b""],
+                       proc.stderr.fileno(): ["err", b""]}
+            sel.register(proc.stdout.fileno(), selectors.EVENT_READ)
+            sel.register(proc.stderr.fileno(), selectors.EVENT_READ)
+            killed = False
+            while streams and not killed:
+                events = sel.select(timeout=min(10.0, max(0.5, remaining())))
+                for key, _ in events:
+                    fd = key.fd
+                    tag, buf = streams[fd]
+                    try:
+                        chunk = os.read(fd, 1 << 16)
+                    except BlockingIOError:
+                        continue
+                    if not chunk:
+                        sel.unregister(fd)
+                        del streams[fd]
+                        continue
+                    last_activity = time.time()
+                    buf += chunk
+                    *lines, rest = buf.split(b"\n")
+                    streams[fd][1] = rest
+                    for raw in lines:
+                        self._consume(tag, raw.decode("utf-8", "replace"),
+                                      state, on_result)
+                # deadline/stall enforcement runs EVERY iteration — a hung
+                # worker that still prints must not dodge the watchdog
+                if remaining() <= 5:
+                    log("bench: GLOBAL DEADLINE — killing worker")
+                    proc.kill()
+                    killed = True
+                elif time.time() - last_activity > STALL_S:
+                    log(f"bench: worker stalled >{STALL_S:.0f}s on "
+                        f"{state['current_q']}; killing + poisoning")
+                    proc.kill()
+                    killed = True
+            proc.wait()
+            current_q = state["current_q"]
+            failed = killed or (proc.returncode != 0 and bool(todo))
+            if failed:
+                reason = (f"stalled >{STALL_S:.0f}s (killed)" if killed
+                          else f"worker died rc={proc.returncode}")
+                log(f"bench: {reason} on {current_q}")
+                if current_q and current_q in todo:
+                    self.poisoned.append(current_q)
+                    self.results[current_q] = {"error": reason}
+                    todo.remove(current_q)
+                restarts += 1
+                if remaining() <= 5:
+                    break
+                continue
+            break  # clean exit (finished or hit its own deadline)
+        for q in todo:
+            self.results.setdefault(
+                q, {"error": "not run (budget exhausted)"})
+        return self.results
+
+
+def bench_block(sf: float, queries: list, trials: int) -> tuple:
     from igloo_tpu.bench.runner import ensure_staged
     from igloo_tpu.bench.tpch_pandas import PANDAS_QUERIES
 
     stage = ensure_staged(sf)
     import pyarrow.parquet as pq
     n_li = pq.read_metadata(os.path.join(stage, "lineitem.parquet")).num_rows
-    log(f"TPC-H sf={sf}: lineitem={n_li} rows (staged at {stage})")
+    log(f"TPC-H sf={sf}: lineitem={n_li} rows (staged at {stage}); "
+        f"{remaining():.0f}s of budget left")
 
-    timeout = float(os.environ.get("BENCH_QUERY_TIMEOUT", "1800"))
     block = {"sf": sf, "lineitem_rows": n_li, "queries": {}}
     ours_tp, base_tp = [], []
-    pdt = None
-    for q in queries:
-        cmd = [sys.executable, "-m", "igloo_tpu.bench.runner",
-               q, str(sf), stage, str(trials)]
-        try:
-            t0 = time.perf_counter()
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=timeout, cwd=os.path.dirname(
-                                      os.path.abspath(__file__)))
-            took = time.perf_counter() - t0
-        except subprocess.TimeoutExpired:
-            log(f"{q}: TIMEOUT after {timeout:.0f}s (recorded, continuing)")
-            block["queries"][q] = {"error": f"timeout after {timeout:.0f}s"}
-            continue
-        line = next((ln for ln in proc.stdout.splitlines()
-                     if ln.startswith("{")), None)
-        if proc.returncode != 0 or line is None:
-            tail = (proc.stderr or "").strip().splitlines()[-3:]
-            log(f"{q}: FAILED rc={proc.returncode}: {' | '.join(tail)}")
-            block["queries"][q] = {"error": f"rc={proc.returncode}"}
-            continue
-        r = json.loads(line)
-        med, lo, hi = _spread(r["warm_trials"])
+    pdt_box = {}
+
+    def on_result(q, rec):
+        if "error" in rec:
+            log(f"{q}: ERROR {rec['error']}")
+            block["queries"][q] = rec
+            return
+        med, lo, hi = _spread(rec["warm_trials"])
         rps = n_li / med
-        rec = {"cold_s": r["cold_s"], "warm_med_s": med, "warm_min_s": lo,
-               "warm_max_s": hi, "cached_s": r["cached_s"],
-               "rows_per_s": round(rps), "proc_s": round(took, 1)}
+        out = {"cold_s": rec["cold_s"], "warm_med_s": med, "warm_min_s": lo,
+               "warm_max_s": hi, "cached_s": rec["cached_s"],
+               "rows_per_s": round(rps)}
         if q in PANDAS_QUERIES:
-            if pdt is None:
-                pdt = _pandas_tables(stage)
+            if "t" not in pdt_box:
+                pdt_box["t"] = _pandas_tables(stage)
             try:
                 times = []
-                for _ in range(max(trials, 3)):
+                for _ in range(max(min(trials, 5), 3)):
                     t0 = time.perf_counter()
-                    PANDAS_QUERIES[q](pdt)
+                    PANDAS_QUERIES[q](pdt_box["t"])
                     times.append(time.perf_counter() - t0)
                 pmed, plo, phi = _spread(times)
-                rec.update(pandas_med_s=pmed, pandas_min_s=plo,
-                           pandas_max_s=phi,
-                           vs_pandas=round(pmed / med, 3))
+                out.update(pandas_med_s=pmed, pandas_min_s=plo,
+                           pandas_max_s=phi, vs_pandas=round(pmed / med, 3))
                 base_tp.append(n_li / pmed)
                 ours_tp.append(rps)
             except Exception as e:
                 log(f"{q}: pandas baseline FAILED {type(e).__name__}: {e}")
-        block["queries"][q] = rec
-        log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s [{lo:.4f},{hi:.4f}] "
-            f"({rps:,.0f} rows/s) pandas={rec.get('pandas_med_s', '-')}s "
-            f"vs_pandas={rec.get('vs_pandas', '-')}")
+        block["queries"][q] = out
+        log(f"{q}: cold={out['cold_s']:.2f}s warm={med:.4f}s [{lo:.4f},{hi:.4f}] "
+            f"({rps:,.0f} rows/s) pandas={out.get('pandas_med_s', '-')}s "
+            f"vs_pandas={out.get('vs_pandas', '-')}")
+
+    results = SweepDriver(stage, queries, trials).run(on_result)
+    # stalled / crashed / never-run queries still appear in the artifact
+    for q, rec in results.items():
+        if q not in block["queries"]:
+            log(f"{q}: {rec.get('error', '?')}")
+            block["queries"][q] = rec
     return block, ours_tp, base_tp
 
 
@@ -142,24 +262,33 @@ def main() -> None:
     queries = os.environ.get("BENCH_QUERIES", ",".join(all_q)).split(",")
     trials = int(os.environ.get("BENCH_TRIALS", "5"))
 
-    import jax
-    log(f"device: {jax.devices()[0]} backend={jax.default_backend()}")
-
+    log(f"bench: deadline {DEADLINE_S:.0f}s, stall timeout {STALL_S:.0f}s")
     block, ours_tp, base_tp = bench_block(sf, queries, trials)
     detail = dict(block)
 
+    # SF10 block: staging ~3 min when cold + ~1.5 GB upload through the
+    # tunnel; only attempt with real budget left
     if os.environ.get("BENCH_SF10", "1") == "1":
         sf10_q = os.environ.get("BENCH_SF10_QUERIES", "q3,q5").split(",")
-        try:
-            sf10_block, _, _ = bench_block(10.0, sf10_q, max(trials - 2, 3))
-            detail["sf10"] = sf10_block
-        except Exception as e:
-            log(f"sf10 block FAILED: {type(e).__name__}: {e}")
-            detail["sf10"] = {"error": f"{type(e).__name__}: {e}"}
+        from igloo_tpu.bench.runner import stage_dir
+        staged = os.path.exists(os.path.join(stage_dir(10.0), ".complete"))
+        need = 240 if staged else 450
+        if remaining() > need:
+            try:
+                sf10_block, _, _ = bench_block(10.0, sf10_q,
+                                               max(trials - 2, 3))
+                detail["sf10"] = sf10_block
+            except Exception as e:
+                log(f"sf10 block FAILED: {type(e).__name__}: {e}")
+                detail["sf10"] = {"error": f"{type(e).__name__}: {e}"}
+        else:
+            log(f"sf10 block skipped: {remaining():.0f}s left < {need}s")
+            detail["sf10"] = {"skipped": f"budget ({remaining():.0f}s left)"}
 
     def gmean(xs):
         return math.exp(sum(math.log(x) for x in xs) / len(xs)) if xs else 0.0
     gmean_ours, gmean_base = gmean(ours_tp), gmean(base_tp)
+    detail["elapsed_s"] = round(time.time() - T_START, 1)
     result = {
         "metric": "tpch_warm_rows_per_s",
         "value": round(gmean_ours),

@@ -1,14 +1,17 @@
-"""Per-query bench worker: runs ONE TPC-H query in its own process.
+"""Staging helpers + a single-query debug worker.
 
-bench.py invokes this as a subprocess with a hard timeout, so a pathological
-XLA compile (observed: tens of minutes on some join-heavy shapes, see the
-nofuse sentinel in exec/executor.py) costs one query's budget instead of
-hanging the whole benchmark. Prints exactly one JSON line with the timings.
+The production sweep is igloo_tpu/bench/sweep.py (one process for ALL
+queries, so tables cross the tunnel once); bench.py orchestrates it with a
+stall watchdog. This module keeps the shared staging helpers (`ensure_staged`,
+`stage_dir`, `make_engine`) and a per-query CLI useful for isolating one
+query's behavior in a fresh process:
 
-Tables are staged to parquet ONCE by bench.py (same generated data for every
-query and for the pandas baselines); workers register the parquet files, so
-per-process startup is seconds. The persistent XLA cache + cardinality-hint
-store make repeated invocations start warm.
+    python -m igloo_tpu.bench.runner q7 1 /tmp/igloo_bench_sf1 5
+
+A pathological XLA compile in-process is routed to the staged executor by the
+hint store's armed `nofuse` sentinel (exec/fused.py arms it before each
+first-ever fused compile and clears it after success; a process killed
+mid-compile leaves it armed, so the NEXT process avoids the fused program).
 """
 from __future__ import annotations
 

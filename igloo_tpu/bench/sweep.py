@@ -1,0 +1,118 @@
+"""Single-process TPC-H sweep worker: ALL queries in one engine/process.
+
+Round-4 post-mortem (VERDICT.md weak #1): the per-query-subprocess design made
+every query re-upload its input tables through the axon tunnel. The tunnel
+moves ~10-20 MB/s, so 22 subprocesses paid 13-118 s of "cold compile" that was
+actually mostly data transfer — the persistent XLA cache was hitting all
+along. This worker amortizes the upload: ONE process, one engine, the
+column-granular HBM scan cache (exec/executor.py _exec_scan) ships each column
+at most once, and per-query cold cost drops to trace+lower plus a compile-cache
+read (~1-4 s).
+
+Protocol (consumed by bench.py, which adds the watchdog):
+  stdout: exactly one JSON line per finished query
+          {"q": .., "cold_s": .., "warm_trials": [..], "cached_s": ..}
+  stderr: "SWEEP-START <q>" before each query (stall attribution: when the
+          orchestrator kills a hung worker it knows which query to poison),
+          plus human-readable progress.
+
+A poison list (queries that hung a previous worker) is passed via
+--skip; a deadline (unix epoch seconds) via --deadline makes the worker skip
+remaining queries cleanly rather than being killed mid-fetch.
+"""
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+from igloo_tpu.bench.runner import make_engine  # shared staging helper
+
+
+def run_query(engine, sql: str, trials: int) -> dict:
+    """cold -> hint-adoption re-runs -> warm trials -> result-cached run."""
+    t0 = time.perf_counter()
+    engine.execute(sql)
+    cold = time.perf_counter() - t0
+    # adopt cardinality hints (one recompile each) until run time stabilizes;
+    # with the persistent hint store this loop is a no-op after the first-ever
+    # sweep (the process starts on the hinted program)
+    prev = cold
+    for _ in range(3):
+        engine.result_cache.clear()
+        t0 = time.perf_counter()
+        engine.execute(sql)
+        cur = time.perf_counter() - t0
+        if cur > 0.5 * prev:
+            break
+        prev = cur
+    warm = []
+    for _ in range(trials):
+        engine.result_cache.clear()
+        t0 = time.perf_counter()
+        engine.execute(sql)
+        warm.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    engine.execute(sql)
+    cached = time.perf_counter() - t0
+    return {"cold_s": round(cold, 4),
+            "warm_trials": [round(w, 4) for w in warm],
+            "cached_s": round(cached, 4)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", required=True)
+    ap.add_argument("--queries", required=True, help="csv of query ids")
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--skip", default="", help="csv of poisoned query ids")
+    ap.add_argument("--deadline", type=float, default=0.0,
+                    help="unix epoch seconds; skip queries past this")
+    args = ap.parse_args(argv)
+
+    from igloo_tpu.bench.tpch import QUERIES
+    engine = make_engine(args.stage)
+    skip = set(q for q in args.skip.split(",") if q)
+    queries = [q for q in args.queries.split(",") if q]
+
+    per_q = []  # completed query durations, for the deadline margin
+    for q in queries:
+        if q in skip:
+            print(json.dumps({"q": q, "error": "poisoned (hung a previous "
+                              "worker)"}), flush=True)
+            continue
+        if args.deadline:
+            # leave room for one more query of typical observed cost
+            margin = max(per_q[-3:]) if per_q else 60.0
+            if time.time() + margin > args.deadline:
+                log(f"SWEEP-DEADLINE before {q} "
+                    f"(margin {margin:.0f}s); stopping cleanly")
+                break
+        log(f"SWEEP-START {q}")
+        t0 = time.perf_counter()
+        try:
+            rec = run_query(engine, QUERIES[q], args.trials)
+        except Exception as e:  # record, keep sweeping
+            log(f"{q}: FAILED {type(e).__name__}: {e}")
+            print(json.dumps({"q": q,
+                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+            continue
+        took = time.perf_counter() - t0
+        per_q.append(took)
+        rec["q"] = q
+        print(json.dumps(rec), flush=True)
+        gc.collect()
+    log("SWEEP-DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,9 @@ class MemTable:
     """In-memory table over a pyarrow Table (reference uses DataFusion MemTable for
     the CLI's sample `users` table, crates/igloo/src/main.rs:59-77)."""
 
+    # repeated reads return identical row order (column-granular scan cache)
+    stable_row_order = True
+
     def __deepcopy__(self, memo):
         # providers are shared by plan/expression copies (see copy_plan)
         return self

@@ -21,6 +21,8 @@ from igloo_tpu.types import Schema
 
 
 class CsvTable:
+    stable_row_order = True  # deterministic file order + sequential parse
+
     def __deepcopy__(self, memo):
         # providers are shared by plan/expression copies (see copy_plan)
         return self

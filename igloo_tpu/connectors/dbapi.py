@@ -59,6 +59,11 @@ def _render_pushdown(filters) -> str:
 class DbApiTable:
     """A remote table reachable through a DBAPI connection factory."""
 
+    # a SELECT with no ORDER BY may return rows in any order, so separate
+    # reads cannot be stitched column-wise (executor falls back to the
+    # whole-batch scan cache)
+    stable_row_order = False
+
     def __init__(self, connect: Callable, table: str,
                  quote: str = '"'):
         self._connect = connect

@@ -35,6 +35,8 @@ _CONTENT_DATA = 0
 
 
 class IcebergTable:
+    stable_row_order = True  # manifest-ordered data files, deterministic
+
     def __deepcopy__(self, memo):
         # providers are shared by plan/expression copies (see copy_plan)
         return self

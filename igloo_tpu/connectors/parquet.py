@@ -25,6 +25,9 @@ from igloo_tpu.types import Schema
 class ParquetTable:
     """One file, a directory of files, or a glob pattern."""
 
+    # deterministic file/row-group order -> scans may be cached per column
+    stable_row_order = True
+
     def __init__(self, path: str):
         import threading
         self.path = path

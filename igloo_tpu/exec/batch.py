@@ -382,7 +382,17 @@ def _arrow_column_to_numpy(arr: pa.ChunkedArray, dtype: DataType):
     null_mask = None
     if combined.null_count:
         null_mask = np.asarray(pa.compute.is_null(combined).to_numpy(zero_copy_only=False))
-        fill = False if dtype.id == TypeId.BOOL else (0.0 if dtype.is_float else 0)
+        if dtype.id == TypeId.BOOL:
+            fill = False
+        elif dtype.is_float:
+            fill = 0.0
+        else:
+            # fill integer-family nulls with the non-null MIN, not 0: null
+            # lanes are masked everywhere (like dead lanes), but a 0 fill in
+            # e.g. a timestamp column would drag the value range to [0, hi]
+            # and defeat the offset-shrink transfer codec (exec/codec.py)
+            mn = pa.compute.min(combined).as_py()
+            fill = 0 if mn is None else mn
         combined = pa.compute.fill_null(combined, fill)
     np_vals = combined.to_numpy(zero_copy_only=False)
     np_vals = np.asarray(np_vals).astype(dtype.device_dtype(), copy=False)
@@ -404,12 +414,46 @@ def _int_bounds(np_vals: np.ndarray, null_mask, dtype: DataType):
     return (int(valid.min()), int(valid.max()))
 
 
-def _pad(a: np.ndarray, capacity: int) -> np.ndarray:
-    if len(a) == capacity:
-        return a
-    out = np.zeros((capacity,), dtype=a.dtype)
-    out[: len(a)] = a
-    return out
+
+
+def host_decode_column(arr: pa.ChunkedArray, f: Field,
+                       dictionaries: Optional[dict[str, DictInfo]] = None):
+    """Arrow column -> host-side (np_vals, null_mask, dinfo, bounds) in the
+    engine lane dtype (string columns become int32 dictionary ids)."""
+    if f.dtype.is_string:
+        pre = dictionaries.get(f.name) if dictionaries else None
+        ids, null_mask, dinfo = _encode_string_column(arr, pre)
+        return ids, null_mask, dinfo, None
+    np_vals, null_mask = _arrow_column_to_numpy(arr, f.dtype)
+    bounds = _int_bounds(np_vals, null_mask, f.dtype)
+    return np_vals, null_mask, None, bounds
+
+
+def device_columns(decoded: list, fields: list, cap: int,
+                   device=None) -> list[DeviceColumn]:
+    """Upload host-decoded columns as DeviceColumns, narrowed losslessly for
+    the transfer (exec/codec.py) and widened back to lane dtypes on device in
+    ONE dispatch. Dead lanes (index >= n) carry the codec pad value — kernels
+    must never read them unmasked (they were arbitrary zeros before too)."""
+    from igloo_tpu.exec.codec import upload_columns
+    plans = []
+    for (np_vals, null_mask, _dinfo, _bounds) in decoded:
+        lane = np_vals.dtype
+        plans.append((np_vals, lane, cap))
+        if null_mask is not None:
+            plans.append((null_mask, None, cap))
+    dev = upload_columns(plans, device=device)
+    cols: list[DeviceColumn] = []
+    i = 0
+    for f, (np_vals, null_mask, dinfo, bounds) in zip(fields, decoded):
+        dev_vals = dev[i]
+        i += 1
+        nulls = None
+        if null_mask is not None:
+            nulls = dev[i]
+            i += 1
+        cols.append(DeviceColumn(f.dtype, dev_vals, nulls, dinfo, bounds))
+    return cols
 
 
 def from_arrow(
@@ -419,38 +463,17 @@ def from_arrow(
     dictionaries: Optional[dict[str, DictInfo]] = None,
     device=None,
 ) -> DeviceBatch:
-    """pyarrow Table -> DeviceBatch (host decode -> device_put into HBM)."""
+    """pyarrow Table -> DeviceBatch (host decode -> narrowed device_put into
+    HBM -> on-device widen, one dispatch for the whole batch)."""
+    from igloo_tpu.exec.codec import live_lane
     if schema is None:
         schema = schema_from_arrow(table.schema)
     n = table.num_rows
     cap = capacity or round_capacity(n)
-    cols: list[DeviceColumn] = []
-    for f in schema:
-        arr = table.column(f.name)
-        if f.dtype.is_string:
-            pre = dictionaries.get(f.name) if dictionaries else None
-            ids, null_mask, dinfo = _encode_string_column(arr, pre)
-            vals = _pad(ids, cap)
-            dev_vals = jnp.asarray(vals) if device is None else jax.device_put(vals, device)
-            nulls = None
-            if null_mask is not None:
-                nm = _pad(null_mask, cap)
-                nulls = jnp.asarray(nm) if device is None else jax.device_put(nm, device)
-            cols.append(DeviceColumn(f.dtype, dev_vals, nulls, dinfo))
-        else:
-            np_vals, null_mask = _arrow_column_to_numpy(arr, f.dtype)
-            bounds = _int_bounds(np_vals, null_mask, f.dtype)
-            vals = _pad(np_vals, cap)
-            dev_vals = jnp.asarray(vals) if device is None else jax.device_put(vals, device)
-            nulls = None
-            if null_mask is not None:
-                nm = _pad(null_mask, cap)
-                nulls = jnp.asarray(nm) if device is None else jax.device_put(nm, device)
-            cols.append(DeviceColumn(f.dtype, dev_vals, nulls, None, bounds))
-    live = np.zeros((cap,), dtype=bool)
-    live[:n] = True
-    live_dev = jnp.asarray(live) if device is None else jax.device_put(live, device)
-    return DeviceBatch(schema, cols, live_dev)
+    decoded = [host_decode_column(table.column(f.name), f, dictionaries)
+               for f in schema]
+    cols = device_columns(decoded, list(schema), cap, device=device)
+    return DeviceBatch(schema, cols, live_lane(cap, n, device=device))
 
 
 def to_arrow(batch: DeviceBatch) -> pa.Table:

@@ -117,13 +117,26 @@ class SnapshotLRU:
 
 
 class BatchCache(SnapshotLRU):
-    """HBM scan cache: DeviceBatch values keyed by
-    (table, projection, pushed-filter fingerprint, partition)."""
+    """HBM scan cache. Two entry shapes, both with key[0] = table name:
+
+    - column-granular (providers with stable row order):
+      (table, filter-fp, partition, 'col', name) -> (DeviceColumn, n_rows) and
+      (table, filter-fp, partition, 'live')      -> live lane array;
+      scans assemble batches from these so overlapping projections share the
+      uploaded lanes (written via `put_entry`).
+    - whole-batch (order-unstable providers, e.g. DBAPI):
+      (table, projection, filter-fp, partition) -> DeviceBatch (via `put`)."""
 
     counter_prefix = "cache"
 
     def put(self, key: tuple, batch: DeviceBatch, snapshot: object) -> None:
         super().put(key, batch, snapshot, batch.nbytes())
+
+    def put_entry(self, key: tuple, value: object, snapshot: object,
+                  nbytes: int, table: str) -> None:
+        """Column-granular entries; `table` must equal key[0] (invalidation)."""
+        assert key and key[0] == table
+        super().put(key, value, snapshot, nbytes)
 
     def _match_table(self, key, entry, table_key: str) -> bool:
         return bool(key) and key[0] == table_key

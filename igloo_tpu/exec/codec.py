@@ -1,0 +1,221 @@
+"""Lossless narrow-transfer codec for the host->HBM boundary.
+
+The engine computes on int64/float64 lanes (SQL semantics), but shipping those
+lanes verbatim wastes the scarcest resource on a tunneled TPU: host<->device
+bandwidth (measured ~10-20 MB/s through the axon tunnel, flat per byte — see
+BASELINE.md). A 6M-row float64 column is 48 MB on the wire even when every
+value is a whole number under 50.
+
+This codec picks, per column and on the host, the smallest *provably lossless*
+carrier representation, uploads that, and widens back to the engine lane dtype
+on device inside ONE fused jit per batch (so the widening costs one dispatch,
+not one per column). Carriers, tried narrowest-first:
+
+- integer family (int64/int32/date32/timestamp lanes): offset shrink —
+  ``carrier = v - off`` cast to int8/int16/int32 when the value RANGE fits;
+  widen = ``carrier.astype(lane) + off``. Exact by construction.
+- float lanes: scaled-decimal shrink — ``c = rint(v * scale)`` for scale in
+  {1, 100, 10000} when c fits int32 AND ``c / scale == v`` elementwise on the
+  host (float64 division, verified value by value); widen =
+  ``c.astype(f64) / scale``. TPC-H prices/discounts/taxes are decimals with
+  <= 4 fractional digits, so they ride int8/int16/int32 carriers. IEEE-754
+  division is deterministic, so the host check guarantees the device result
+  bit-for-bit (the TPU's emulated f64 divide is IEEE-correct; verified by
+  tests/test_codec.py on CPU and by the bench harness on device).
+- float64 -> float32 round-trip: when ``v == f32(v)`` exactly (NaN-aware).
+- everything else ships as the lane dtype unchanged.
+
+The reference engine has no analog (it streams Arrow RecordBatches in-process,
+reference crates/engine/src/operators/parquet_scan.rs:40-85); this boundary
+exists only because the TPU sits across an interconnect.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_I8 = (-(1 << 7), (1 << 7) - 1)
+_I16 = (-(1 << 15), (1 << 15) - 1)
+_I32 = (-(1 << 31), (1 << 31) - 1)
+_INT_STEPS = ((np.int8, _I8), (np.int16, _I16), (np.int32, _I32))
+
+
+@dataclass(frozen=True)
+class WidenSpec:
+    """How to reconstruct the engine lane from the carrier, on device.
+
+    lane:   target numpy dtype name ('int64', 'float64', ...)
+    offset: integer added after the cast (int paths; 0 for float paths)
+    scale:  divisor applied after the cast (float paths; 1 = none)
+    """
+    lane: str
+    offset: int = 0
+    scale: float = 1.0
+
+    def widen(self, a: jax.Array, scale_arg=None, offset_arg=None) -> jax.Array:
+        """`scale_arg`/`offset_arg`, when given, must be RUNTIME 0-d arrays
+        holding self.scale/self.offset. Scale: baking the divisor in as a
+        constant lets XLA rewrite the divide into a multiply by the (inexact)
+        reciprocal, which breaks the host-verified exactness for ~13% of
+        scaled-decimal values. Offset: it is data-dependent (the column min),
+        so baking it in would compile a fresh widen program per distinct min
+        (one per chunk in the chunked executor)."""
+        lane = jnp.dtype(self.lane)
+        if self.scale != 1.0:
+            s = (scale_arg.astype(lane) if scale_arg is not None
+                 else lane.type(self.scale))
+            return a.astype(lane) / s
+        if self.offset:
+            off = (offset_arg.astype(lane) if offset_arg is not None
+                   else lane.type(self.offset))
+            return a.astype(lane) + off
+        if a.dtype != lane:
+            return a.astype(lane)
+        return a
+
+    def key(self) -> tuple:
+        """Static jit-cache key: everything EXCEPT the data-dependent payload
+        values (offset rides in at runtime; only its presence is static)."""
+        return (self.lane, self.scale != 1.0, self.scale, bool(self.offset))
+
+
+def _shrink_int(v: np.ndarray, lane: np.dtype):
+    """Offset-shrink an integer array; None when it cannot shrink."""
+    if v.size == 0:
+        return v.astype(np.int8), WidenSpec(lane.name)
+    lo, hi = int(v.min()), int(v.max())
+    for nd, (nlo, nhi) in _INT_STEPS:
+        nd_ = np.dtype(nd)
+        if nd_.itemsize >= lane.itemsize:
+            return None
+        span = hi - lo
+        if span <= nhi - nlo:
+            # center the carrier range when an offset is needed at all
+            off = 0 if (nlo <= lo and hi <= nhi) else lo - nlo
+            return (v - off).astype(nd), WidenSpec(lane.name, offset=off)
+    return None
+
+
+_FLOAT_SCALES = (1.0, 100.0, 10000.0)
+
+
+def _shrink_float(v: np.ndarray, lane: np.dtype):
+    """Scaled-decimal or f32 round-trip shrink for a float array."""
+    if v.size == 0:
+        return v.astype(np.int8), WidenSpec(lane.name)
+    finite = np.isfinite(v)
+    if finite.all():
+        for scale in _FLOAT_SCALES:
+            c = np.rint(v * scale)
+            if not ((c >= _I32[0]).all() and (c <= _I32[1]).all()):
+                continue
+            ci = c.astype(np.int64)
+            # exact host verification: the device replays this same divide
+            if not np.array_equal(ci.astype(lane) / lane.type(scale), v):
+                continue
+            shrunk = _shrink_int(ci, np.dtype(np.int64))
+            if shrunk is not None and shrunk[0].dtype.itemsize < lane.itemsize:
+                nv, _ = shrunk
+                if shrunk[1].offset == 0:
+                    return nv, WidenSpec(lane.name, scale=scale)
+            if lane.itemsize > 4:
+                return ci.astype(np.int32), WidenSpec(lane.name, scale=scale)
+            break
+    if lane == np.float64:
+        f32 = v.astype(np.float32)
+        if np.array_equal(f32.astype(np.float64), v, equal_nan=True):
+            return f32, WidenSpec(lane.name)
+    return None
+
+
+def shrink(np_vals: np.ndarray, lane: np.dtype):
+    """-> (carrier ndarray, WidenSpec) | None when no narrowing applies.
+
+    `np_vals` must already be in the engine lane dtype (nulls pre-filled with
+    0/False so sentinel values cannot break range analysis)."""
+    if lane.kind in ("i", "u") and np_vals.dtype == lane:
+        return _shrink_int(np_vals, lane)
+    if lane.kind == "f" and np_vals.dtype == lane:
+        return _shrink_float(np_vals, lane)
+    return None
+
+
+@functools.lru_cache(maxsize=512)
+def _widen_jit(specs: tuple, caps: tuple):
+    """One jit that widens a whole batch of carriers in a single dispatch.
+    Scales and offsets ride in as runtime vectors (see WidenSpec.widen);
+    `specs` here are the data-independent WidenSpec.key() tuples plus carrier
+    dtypes, so distinct column minima share one compiled program."""
+    def fn(arrs, scales, offsets):
+        out = []
+        for i, ((lane, scaled, scale, has_off), a) in enumerate(
+                zip(specs, arrs)):
+            spec = WidenSpec(lane, offset=1 if has_off else 0,
+                             scale=scale if scaled else 1.0)
+            out.append(spec.widen(a, scales[i] if scaled else None,
+                                  offsets[i] if has_off else None))
+        return out
+    return jax.jit(fn)
+
+
+def _pad_to(a: np.ndarray, cap: int) -> np.ndarray:
+    if len(a) == cap:
+        return a
+    out = np.zeros((cap,), dtype=a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+def upload_columns(plans: list, device=None) -> list:
+    """Upload a batch of columns with narrowing, ONE widen dispatch total.
+
+    `plans` is a list of (np_array, lane_dtype | None, capacity); lane None
+    means the array ships as-is after padding (bool masks). Narrowing is
+    decided over the UNPADDED values (so pad zeros cannot drag the value range)
+    and the carrier is zero-padded — a dead lane therefore widens to the
+    spec's offset, which is 0 on every path except offset-shrink. Returns the
+    device arrays in the engine lane dtypes, order preserved."""
+    put = (jnp.asarray if device is None
+           else functools.partial(jax.device_put, device=device))
+    out: list = [None] * len(plans)
+    widen_idx: list[int] = []
+    widen_specs: list[WidenSpec] = []
+    widen_arrs: list = []
+    for i, (arr, lane, cap) in enumerate(plans):
+        shrunk = shrink(arr, np.dtype(lane)) if lane is not None else None
+        if shrunk is None:
+            out[i] = put(_pad_to(arr, cap))
+            continue
+        carrier, spec = shrunk
+        widen_idx.append(i)
+        widen_specs.append(spec)
+        widen_arrs.append(put(_pad_to(carrier, cap)))
+    if widen_idx:
+        caps = tuple((a.shape, a.dtype.name) for a in widen_arrs)
+        scales = put(np.asarray([s.scale for s in widen_specs],
+                                dtype=np.float64))
+        offsets = put(np.asarray([s.offset for s in widen_specs],
+                                 dtype=np.int64))
+        wide = _widen_jit(tuple(s.key() for s in widen_specs), caps)(
+            widen_arrs, scales, offsets)
+        for i, w in zip(widen_idx, wide):
+            out[i] = w
+    return out
+
+
+@functools.lru_cache(maxsize=64)
+def _live_jit(cap: int):
+    return jax.jit(lambda n: jnp.arange(cap, dtype=jnp.int32) < n)
+
+
+def live_lane(cap: int, n: int, device=None):
+    """Selection mask with the first `n` lanes set, built ON DEVICE from a
+    4-byte scalar instead of shipping `cap` bool bytes over the tunnel."""
+    nn = np.int32(n)
+    nd = jnp.asarray(nn) if device is None else jax.device_put(nn, device)
+    return _live_jit(int(cap))(nd)

@@ -31,7 +31,8 @@ from igloo_tpu.exec.aggregate import (
     AggSpec, aggregate_batch, distinct_batch, minmax_order_arg, seg_dims_for,
 )
 from igloo_tpu.exec.batch import (
-    DeviceBatch, DeviceColumn, DictInfo, from_arrow, round_capacity, to_arrow,
+    DeviceBatch, DeviceColumn, DictInfo, device_columns, from_arrow,
+    host_decode_column, round_capacity, to_arrow,
 )
 from igloo_tpu.exec.expr_compile import (
     Compiled, ConstPool, Env, ExprCompiler, _unify_dicts,
@@ -49,14 +50,19 @@ from igloo_tpu.utils import tracing
 
 _SHRINK_FACTOR = 4  # shrink a batch when capacity > factor * needed
 
+_SENTINEL = object()  # "use the plan's projection" marker for read_scan_table
 
-def read_scan_table(plan: L.Scan) -> pa.Table:
+
+def read_scan_table(plan: L.Scan, projection=_SENTINEL) -> pa.Table:
     """Host-side scan IO honoring the plan's partition restriction. Replaces
     the reference's whole-table-only reads (parquet_scan.rs streams fixed
     1024-row batches but custom operators are single-stream) with explicit
-    provider partitions the distributed planner / chunked executor slice."""
+    provider partitions the distributed planner / chunked executor slice.
+    `projection` overrides the plan's (the column-granular scan cache reads
+    only the columns it is missing)."""
+    proj = plan.projection if projection is _SENTINEL else projection
     if plan.partition is None:
-        return plan.provider.read(projection=plan.projection,
+        return plan.provider.read(projection=proj,
                                   filters=plan.pushed_filters)
     tok_fn = getattr(plan.provider, "partition_token", None)
     if plan.partition_token is not None and tok_fn is not None:
@@ -66,11 +72,11 @@ def read_scan_table(plan: L.Scan) -> pa.Table:
             raise ConnectorError(
                 f"partition index for {plan.table} changed since planning "
                 "(source files moved/replaced); re-plan the query")
-    parts = [plan.provider.read_partition(i, projection=plan.projection,
+    parts = [plan.provider.read_partition(i, projection=proj,
                                           filters=plan.pushed_filters)
              for i in plan.partition]
     return pa.concat_tables(parts) if parts else \
-        plan.provider.read(projection=plan.projection,
+        plan.provider.read(projection=proj,
                            filters=plan.pushed_filters).slice(0, 0)
 
 
@@ -215,10 +221,28 @@ class Executor:
         from igloo_tpu.exec.batch import arrow_from_host
         comp = FusedCompiler(self)
         run, key, meta = comp.compile(plan)
+        # `nofuse` sentinel: armed in the persistent store before a
+        # first-in-process fused compile, cleared on success. A process killed
+        # mid-compile (pathological XLA compiles run 20+ min on some fused
+        # join shapes — BASELINE.md) leaves it armed; after two strikes later
+        # processes route this plan to the staged executor instead of
+        # recompiling the program that hung.
+        sentinel = ("nofuse", key)
+        first = ("fused", key) not in self._cache
+        if first and self._hints is not None:
+            strikes = self._hints.get(sentinel) or 0
+            if strikes >= 2:
+                tracing.counter("fused.nofuse_sentinel")
+                raise FusionUnsupported("nofuse_sentinel")
+            self._hints.put(sentinel, strikes + 1)
+            self._hints.flush()
         jf = self._jitted("fused", key, lambda: run)
         tracing.counter("fused.execute")
         big, spec, n_dev, flags, stats = jf(
             [strip_dicts(b) for b in comp.leaves], comp.pool.device_args())
+        if first and self._hints is not None:
+            self._hints.remove(sentinel)
+            self._hints.flush()
         flags_h, stats_h, n, host_live, host_vals, host_nulls = jax.device_get(
             (flags, stats, n_dev, spec.live, [c.values for c in spec.columns],
              [c.nulls for c in spec.columns]))
@@ -309,23 +333,80 @@ class Executor:
     # --- leaves ---
 
     def _exec_scan(self, plan: L.Scan) -> DeviceBatch:
-        key = snap = None
-        if self._batch_cache is not None:
-            from igloo_tpu.exec.cache import provider_snapshot
-            key = (plan.table,
-                   tuple(plan.projection) if plan.projection is not None else None,
-                   expr_fingerprint(plan.pushed_filters), plan.partition)
-            snap = provider_snapshot(plan.provider)
-            hit = self._batch_cache.get(key, snap)
-            if hit is not None:
-                return hit
-        table = read_scan_table(plan)
-        if plan.projection is not None:
-            table = table.select(plan.projection)
-        batch = from_arrow(table, schema=plan.schema)
-        if self._batch_cache is not None:
-            self._batch_cache.put(key, batch, snap)
-        return batch
+        stable = getattr(plan.provider, "stable_row_order", False)
+        if self._batch_cache is None or not stable:
+            # whole-batch path: providers without deterministic row order
+            # (e.g. DBAPI SELECTs with no ORDER BY) must never stitch columns
+            # from separate reads; they get one read per (projection) and a
+            # whole-batch cache entry.
+            key = snap = None
+            if self._batch_cache is not None:
+                from igloo_tpu.exec.cache import provider_snapshot
+                key = (plan.table,
+                       tuple(plan.projection) if plan.projection is not None
+                       else None,
+                       expr_fingerprint(plan.pushed_filters), plan.partition)
+                snap = provider_snapshot(plan.provider)
+                hit = self._batch_cache.get(key, snap)
+                if hit is not None:
+                    return hit
+            table = read_scan_table(plan)
+            if plan.projection is not None:
+                table = table.select(plan.projection)
+            batch = from_arrow(table, schema=plan.schema)
+            if self._batch_cache is not None:
+                self._batch_cache.put(key, batch, snap)
+            return batch
+        # COLUMN-granular HBM cache: entries are per (table, filters,
+        # partition, column), so scans with different projections share the
+        # uploaded lanes they have in common — on a tunneled TPU the upload
+        # is the dominant per-process cost (BASELINE.md: ~10-20 MB/s), so a
+        # 22-query sweep must ship each column at most once. Entry values are
+        # (DeviceColumn, n_rows); n makes the live lane reconstructible after
+        # its entry is evicted without re-reading a column.
+        from igloo_tpu.exec.cache import provider_snapshot
+        from igloo_tpu.exec.codec import live_lane
+        snap = provider_snapshot(plan.provider)
+        base = (plan.table, expr_fingerprint(plan.pushed_filters),
+                plan.partition)
+        cached = {f.name: self._batch_cache.get(base + ("col", f.name), snap)
+                  for f in plan.schema}
+        live = self._batch_cache.get(base + ("live",), snap)
+        missing = [f for f in plan.schema if cached[f.name] is None]
+        known_n = next((v[1] for v in cached.values() if v is not None), None)
+        if live is None and known_n is not None and not missing:
+            cap0 = next(v[0].capacity for v in cached.values() if v is not None)
+            live = live_lane(cap0, known_n)
+            self._batch_cache.put_entry(base + ("live",), live, snap,
+                                        live.nbytes, plan.table)
+        if not missing and live is not None:
+            return DeviceBatch(plan.schema,
+                               [cached[f.name][0] for f in plan.schema], live)
+        proj = [f.name for f in missing]  # non-empty: all-cached paths return above
+        table = read_scan_table(plan, projection=proj).select(proj)
+        n = table.num_rows
+        if known_n is not None and n != known_n:
+            # source changed under an identity snapshot: drop and re-read all
+            self._batch_cache.invalidate_table(plan.table)
+            return self._exec_scan(plan)
+        cap = int(live.shape[0]) if live is not None else (
+            round_capacity(n) if known_n is None
+            else next(v[0].capacity for v in cached.values() if v is not None))
+        decoded = [host_decode_column(table.column(f.name), f)
+                   for f in missing]
+        new_cols = device_columns(decoded, missing, cap)
+        for f, col in zip(missing, new_cols):
+            nbytes = col.values.nbytes + (
+                col.nulls.nbytes if col.nulls is not None else 0)
+            self._batch_cache.put_entry(base + ("col", f.name), (col, n),
+                                        snap, nbytes, plan.table)
+            cached[f.name] = (col, n)
+        if live is None:
+            live = live_lane(cap, n)
+            self._batch_cache.put_entry(base + ("live",), live, snap,
+                                        live.nbytes, plan.table)
+        return DeviceBatch(plan.schema,
+                           [cached[f.name][0] for f in plan.schema], live)
 
     def _exec_values(self, plan: L.Values) -> DeviceBatch:
         n = len(plan.rows)

@@ -45,6 +45,10 @@ class HintStore:
             self._data[d] = int(n)
             self._dirty = True
 
+    def remove(self, key) -> None:
+        if self._data.pop(_digest(key), None) is not None:
+            self._dirty = True
+
     def flush(self) -> None:
         if not self._dirty or not self._path:
             return

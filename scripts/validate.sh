@@ -22,6 +22,13 @@ else
   python -m pytest tests/ -q -m slow
 fi
 
+echo "== pytest (full tier: all 22 TPC-H queries sharded) =="
+if [ "${IGLOO_FULL_TPCH:-0}" = "1" ]; then
+  python -m pytest tests/test_parallel.py -q -k test_sharded_tpch_full
+else
+  echo "IGLOO_FULL_TPCH != 1: skipping the ~10-min full sharded sweep"
+fi
+
 echo "== graft entry (single-chip jit + 8-device dryrun) =="
 python __graft_entry__.py
 

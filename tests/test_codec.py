@@ -1,0 +1,99 @@
+"""Narrow-transfer codec (exec/codec.py): losslessness of every carrier path.
+
+The codec may pick any carrier it proves exact on the host; these tests assert
+the device round-trip reproduces the original lanes bit-for-bit, and that the
+expected carrier families actually engage (so a regression to "ship wide"
+would be caught by the dtype assertions, not just silently slow)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from igloo_tpu.exec import codec
+from igloo_tpu.exec.batch import from_arrow, to_arrow
+from igloo_tpu.types import Schema
+
+
+def roundtrip(table: pa.Table) -> pa.Table:
+    return to_arrow(from_arrow(table))
+
+
+def test_decimal_cents_exact():
+    v = np.round(np.random.default_rng(0).uniform(900.0, 105000.0, 4096) * 100) / 100
+    t = pa.table({"price": v})
+    got = roundtrip(t)
+    assert got.column("price").to_pylist() == v.tolist()
+    shrunk = codec.shrink(v, np.dtype(np.float64))
+    assert shrunk is not None and shrunk[1].scale == 100.0
+    assert shrunk[0].dtype == np.int32
+
+
+def test_small_decimals_ride_int8():
+    v = np.random.default_rng(1).integers(0, 11, 4096) / 100.0  # discounts
+    shrunk = codec.shrink(v, np.dtype(np.float64))
+    assert shrunk is not None and shrunk[0].dtype == np.int8
+    t = pa.table({"d": v})
+    assert roundtrip(t).column("d").to_pylist() == v.tolist()
+
+
+def test_integral_floats_scale_one():
+    v = np.random.default_rng(2).integers(1, 51, 4096).astype(np.float64)
+    shrunk = codec.shrink(v, np.dtype(np.float64))
+    assert shrunk is not None and shrunk[1].scale == 1.0
+    assert shrunk[0].dtype == np.int8
+    assert roundtrip(pa.table({"q": v})).column("q").to_pylist() == v.tolist()
+
+
+def test_irregular_floats_ship_wide():
+    v = np.random.default_rng(3).standard_normal(1024)
+    assert codec.shrink(v, np.dtype(np.float64)) is None
+    assert roundtrip(pa.table({"x": v})).column("x").to_pylist() == v.tolist()
+
+
+def test_f32_roundtrip_carrier():
+    v = (np.random.default_rng(4).standard_normal(1024) * 1e9) \
+        .astype(np.float32).astype(np.float64)  # f32-exact, not scaled-decimal
+    shrunk = codec.shrink(v, np.dtype(np.float64))
+    assert shrunk is not None and shrunk[0].dtype == np.float32
+    assert roundtrip(pa.table({"x": v})).column("x").to_pylist() == v.tolist()
+
+
+def test_nan_inf_ship_exact():
+    v = np.array([1.5, np.nan, np.inf, -np.inf, 0.0])
+    got = roundtrip(pa.table({"x": pa.array(v, type=pa.float64())}))
+    out = got.column("x").to_pylist()
+    assert out[0] == 1.5 and np.isnan(out[1]) and out[2] == np.inf
+
+
+def test_int_offset_shrink_timestamps():
+    base = 1_700_000_000_000_000
+    v = base + np.random.default_rng(5).integers(0, 3_600_000_000, 2048)
+    shrunk = codec.shrink(v, np.dtype(np.int64))
+    assert shrunk is not None and shrunk[1].offset != 0
+    assert shrunk[0].dtype.itemsize <= 8
+    lane = np.dtype(np.int64)
+    widened = np.asarray(shrunk[1].widen(np.asarray(shrunk[0])))
+    assert np.array_equal(widened.astype(lane), v)
+
+
+def test_date_range_rides_i16():
+    v = np.random.default_rng(6).integers(8035, 10592, 4096).astype(np.int32)
+    shrunk = codec.shrink(v, np.dtype(np.int32))
+    assert shrunk is not None and shrunk[0].dtype == np.int16
+
+
+def test_nulls_preserved():
+    t = pa.table({"x": pa.array([1.25, None, 3.75, None], type=pa.float64()),
+                  "s": pa.array(["a", None, "b", "a"])})
+    got = roundtrip(t)
+    assert got.column("x").to_pylist() == [1.25, None, 3.75, None]
+    assert got.column("s").to_pylist() == ["a", None, "b", "a"]
+
+
+def test_big_int64_keys_unshrunk_exact():
+    v = np.random.default_rng(7).integers(-2**62, 2**62, 1024)
+    assert roundtrip(pa.table({"k": v})).column("k").to_pylist() == v.tolist()
+
+
+def test_live_lane():
+    live = np.asarray(codec.live_lane(16, 5))
+    assert live.tolist() == [True] * 5 + [False] * 11
