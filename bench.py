@@ -187,7 +187,13 @@ class SweepDriver:
                 reason = (f"stalled >{STALL_S:.0f}s (killed)" if killed
                           else f"worker died rc={proc.returncode}")
                 log(f"bench: {reason} on {current_q}")
-                if current_q and current_q in todo:
+                if current_q is None:
+                    # startup stall: a query-blind respawn would hang the
+                    # same way and burn the whole budget — give up
+                    log("bench: worker made no progress before failing; "
+                        "not restarting")
+                    break
+                if current_q in todo:
                     self.poisoned.append(current_q)
                     self.results[current_q] = {"error": reason}
                     todo.remove(current_q)
@@ -214,7 +220,6 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
 
     block = {"sf": sf, "lineitem_rows": n_li, "queries": {}}
     ours_tp, base_tp = [], []
-    pdt_box = {}
 
     def on_result(q, rec):
         if "error" in rec:
@@ -223,29 +228,12 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
             return
         med, lo, hi = _spread(rec["warm_trials"])
         rps = n_li / med
-        out = {"cold_s": rec["cold_s"], "warm_med_s": med, "warm_min_s": lo,
-               "warm_max_s": hi, "cached_s": rec["cached_s"],
-               "rows_per_s": round(rps)}
-        if q in PANDAS_QUERIES:
-            if "t" not in pdt_box:
-                pdt_box["t"] = _pandas_tables(stage)
-            try:
-                times = []
-                for _ in range(max(min(trials, 5), 3)):
-                    t0 = time.perf_counter()
-                    PANDAS_QUERIES[q](pdt_box["t"])
-                    times.append(time.perf_counter() - t0)
-                pmed, plo, phi = _spread(times)
-                out.update(pandas_med_s=pmed, pandas_min_s=plo,
-                           pandas_max_s=phi, vs_pandas=round(pmed / med, 3))
-                base_tp.append(n_li / pmed)
-                ours_tp.append(rps)
-            except Exception as e:
-                log(f"{q}: pandas baseline FAILED {type(e).__name__}: {e}")
-        block["queries"][q] = out
-        log(f"{q}: cold={out['cold_s']:.2f}s warm={med:.4f}s [{lo:.4f},{hi:.4f}] "
-            f"({rps:,.0f} rows/s) pandas={out.get('pandas_med_s', '-')}s "
-            f"vs_pandas={out.get('vs_pandas', '-')}")
+        block["queries"][q] = {
+            "cold_s": rec["cold_s"], "warm_med_s": med, "warm_min_s": lo,
+            "warm_max_s": hi, "cached_s": rec["cached_s"],
+            "rows_per_s": round(rps)}
+        log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s "
+            f"[{lo:.4f},{hi:.4f}] ({rps:,.0f} rows/s)")
 
     results = SweepDriver(stage, queries, trials).run(on_result)
     # stalled / crashed / never-run queries still appear in the artifact
@@ -253,6 +241,33 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
         if q not in block["queries"]:
             log(f"{q}: {rec.get('error', '?')}")
             block["queries"][q] = rec
+
+    # pandas baselines AFTER the sweep: both engines get the one CPU to
+    # themselves (overlapping them perturbs both sides' medians)
+    pdt = None
+    for q, out in block["queries"].items():
+        if "error" in out or q not in PANDAS_QUERIES:
+            continue
+        if remaining() < 20:
+            log(f"pandas {q}: skipped (budget)")
+            continue
+        if pdt is None:
+            pdt = _pandas_tables(stage)
+        try:
+            times = []
+            for _ in range(max(min(trials, 5), 3)):
+                t0 = time.perf_counter()
+                PANDAS_QUERIES[q](pdt)
+                times.append(time.perf_counter() - t0)
+            pmed, plo, phi = _spread(times)
+            out.update(pandas_med_s=pmed, pandas_min_s=plo,
+                       pandas_max_s=phi,
+                       vs_pandas=round(pmed / out["warm_med_s"], 3))
+            base_tp.append(n_li / pmed)
+            ours_tp.append(out["rows_per_s"])
+            log(f"{q}: pandas={pmed:.4f}s vs_pandas={out['vs_pandas']}")
+        except Exception as e:
+            log(f"{q}: pandas baseline FAILED {type(e).__name__}: {e}")
     return block, ours_tp, base_tp
 
 

@@ -11,12 +11,15 @@ Parity map against the reference:
   reference ships empty bytes, G1), results are real Arrow IPC streams (the
   reference fabricates a dummy batch, G1), and a server actually implements
   fragment execution (G2).
-- front door: IglooFlightSqlService implements 2 of 9 Flight methods and
-  executes the query TWICE (once in get_flight_info for the schema, once in
-  do_get — crates/api/src/lib.rs:81-149). Here get_flight_info PLANS only
-  (schema comes from the bound plan), do_get executes once, and the full
-  method set is served: handshake, list_flights, get_schema, do_put (table
-  upload), do_action, list_actions.
+- front door: IglooFlightSqlService implements 2 of the proto's 10 Flight
+  methods and executes the query TWICE (once in get_flight_info for the
+  schema, once in do_get — crates/api/src/lib.rs:81-149). Here
+  get_flight_info PLANS only (schema comes from the bound plan), do_get
+  executes once, and the served surface is: handshake (token auth),
+  list_flights, get_flight_info, get_schema, do_get, do_put (table upload),
+  do_exchange (cmd = query stream / path = upload + echo), do_action,
+  list_actions — plus PollFlightInfo as the `poll_flight_info` action
+  (pyarrow's FlightServerBase exposes no server hook for the real RPC).
 """
 from __future__ import annotations
 
@@ -260,6 +263,9 @@ class CoordinatorServer(flight.FlightServerBase):
         mw = rpc.server_middleware()
         if mw is not None:
             kw.setdefault("middleware", mw)
+        ah = rpc.server_auth_handler()
+        if ah is not None:
+            kw.setdefault("auth_handler", ah)
         rpc.warn_if_open_bind(location.split("://")[-1].rsplit(":", 1)[0],
                               "coordinator")
         super().__init__(location, **kw)
@@ -393,6 +399,12 @@ class CoordinatorServer(flight.FlightServerBase):
             return [json.dumps(self.executor.last_metrics).encode()]
         if action.type == "ping":
             return [json.dumps({"workers": len(self.membership.live())}).encode()]
+        if action.type == "poll_flight_info":
+            # body: JSON {"sql": "..."} (do_action parses all bodies as JSON)
+            info = self.get_flight_info(
+                context, flight.FlightDescriptor.for_command(req["sql"]))
+            return [json.dumps({"progress": 1.0, "complete": True}).encode(),
+                    info.serialize()]
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
@@ -401,7 +413,10 @@ class CoordinatorServer(flight.FlightServerBase):
                 ("register_table", "register a table from a provider spec"),
                 ("cluster_status", "membership + catalog snapshot"),
                 ("last_metrics", "per-fragment metrics of the last query"),
-                ("ping", "liveness")]
+                ("ping", "liveness"),
+                ("poll_flight_info",
+                 "PollFlightInfo equivalent: serialized FlightInfo for a "
+                 "SQL command, progress=1.0 (planning completes eagerly)")]
 
     def get_flight_info(self, context, descriptor):
         sql = self._descriptor_sql(descriptor)
@@ -427,6 +442,48 @@ class CoordinatorServer(flight.FlightServerBase):
         name = self._descriptor_table(descriptor)
         table = reader.read_all()
         self.register_table(name, table)
+
+    def do_exchange(self, context, descriptor, reader, writer):
+        """Bidirectional exchange (reference proto flight.proto:127):
+
+        - cmd descriptor: the command is SQL; any uploaded batches are
+          ignored and the query's result streams back.
+        - path descriptor [table]: uploaded batches register the table (as
+          do_put) and the stored table streams back — a round-trip echo a
+          stock client can verify; with no uploaded batches the currently
+          registered table streams back."""
+        if descriptor.descriptor_type == flight.DescriptorType.CMD:
+            sql = descriptor.command.decode()
+            try:
+                table = self.execute_sql(sql)
+            except IglooError as ex:
+                raise flight.FlightServerError(str(ex))
+            writer.begin(table.schema)
+            for batch in table.to_batches():
+                writer.write_batch(batch)
+            return
+        name = self._descriptor_table(descriptor)
+        uploaded = None
+        try:
+            uploaded = reader.read_all()
+        except Exception:
+            uploaded = None  # client opened write-less exchange
+        if uploaded is not None and uploaded.num_rows > 0:
+            self.register_table(name, uploaded)
+        try:
+            table = self.engine.catalog.get(name).read()
+        except Exception as ex:
+            raise flight.FlightServerError(f"exchange: {ex}")
+        writer.begin(table.schema)
+        for batch in table.to_batches():
+            writer.write_batch(batch)
+
+    # The reference proto also declares PollFlightInfo (flight.proto:92);
+    # pyarrow's FlightServerBase has no server hook for it, so the
+    # immediate-complete equivalent is served as the "poll_flight_info"
+    # action (do_action below): it returns the serialized FlightInfo for a
+    # SQL command with progress=1.0 — long-running-query polling semantics
+    # collapse to "already complete" because get_flight_info only PLANS.
 
     def list_flights(self, context, criteria):
         for name in sorted(self.engine.catalog.names()):

@@ -42,12 +42,22 @@ class TokenMiddlewareFactory(flight.ServerMiddlewareFactory):
         self._token = token
 
     def start_call(self, info, headers):
+        if info.method == flight.FlightMethod.HANDSHAKE:
+            return None  # the auth handler itself validates the handshake
         vals = []
         for k, vs in headers.items():
             key = k.decode() if isinstance(k, bytes) else k
-            if key.lower() == _HEADER:
-                vals.extend(v.decode() if isinstance(v, bytes) else v
-                            for v in vs)
+            # handshake-authenticated clients (TokenServerAuthHandler) carry
+            # the session token as gRPC call credentials: pyarrow surfaces
+            # them as auth-token-bin (or authorization: Bearer <tok>)
+            if key.lower() not in (_HEADER, "authorization",
+                                   "auth-token-bin"):
+                continue
+            for v in vs:
+                v = v.decode() if isinstance(v, bytes) else v
+                if key.lower() == "authorization":
+                    v = v.split(" ", 1)[-1]
+                vals.append(v)
         if self._token not in vals:
             raise flight.FlightUnauthenticatedError(
                 "missing or invalid x-igloo-token (set IGLOO_TPU_AUTH_TOKEN)")
@@ -60,6 +70,49 @@ def server_middleware() -> Optional[dict]:
     if tok is None:
         return None
     return {"auth": TokenMiddlewareFactory(tok)}
+
+
+class TokenServerAuthHandler(flight.ServerAuthHandler):
+    """Handshake (reference proto flight.proto:42) wired to the shared
+    token: the client's handshake payload must equal the token; the returned
+    session token is the same secret (carried by pyarrow on later calls as
+    the authorization header). The per-call x-igloo-token middleware stays
+    the primary gate — handshake is the protocol-parity path for stock
+    clients that use `FlightClient.authenticate`."""
+
+    def __init__(self, token: str):
+        super().__init__()
+        self._token = token.encode()
+
+    def authenticate(self, outgoing, incoming):
+        buf = incoming.read()
+        if buf != self._token:
+            raise flight.FlightUnauthenticatedError("bad handshake token")
+        outgoing.write(self._token)
+
+    def is_valid(self, token):
+        if token == self._token:
+            return b"igloo"
+        # middleware-authenticated calls present no handshake session token
+        return b""
+
+
+class TokenClientAuthHandler(flight.ClientAuthHandler):
+    def __init__(self, token: str):
+        super().__init__()
+        self._token = token.encode()
+
+    def authenticate(self, outgoing, incoming):
+        outgoing.write(self._token)
+        self._session = incoming.read()
+
+    def get_token(self):
+        return self._session
+
+
+def server_auth_handler() -> Optional[flight.ServerAuthHandler]:
+    tok = auth_token()
+    return TokenServerAuthHandler(tok) if tok is not None else None
 
 
 def warn_if_open_bind(host: str, what: str) -> None:

@@ -58,6 +58,9 @@ class WorkerServer(flight.FlightServerBase):
         mw = rpc.server_middleware()
         if mw is not None:
             kw.setdefault("middleware", mw)
+        ah = rpc.server_auth_handler()
+        if ah is not None:
+            kw.setdefault("auth_handler", ah)
         rpc.warn_if_open_bind(location.split("://")[-1].rsplit(":", 1)[0],
                               "worker")
         super().__init__(location, **kw)

@@ -37,6 +37,25 @@ class ClusterConfig:
 
 
 @dataclass
+class DistributedConfig:
+    """Multi-host JAX runtime (SURVEY #20 "jax distributed init").
+
+    When `enabled`, `init_distributed()` brings this process into a
+    pod-spanning JAX runtime via `jax.distributed.initialize`: all hosts'
+    chips join ONE global device set, and QueryEngine's mesh then spans hosts
+    — XLA routes intra-host collectives over ICI and cross-host legs over
+    DCN. This is the scale-UP tier; the Flight coordinator/worker fragment
+    tier (cluster/) is the scale-OUT tier for independent engines. The two
+    compose: each fragment worker may itself be a multi-host mesh process
+    group (docs/distributed.md)."""
+    enabled: bool = False
+    coordinator_address: Optional[str] = None  # host:port of process 0
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    local_device_ids: Optional[list[int]] = None
+
+
+@dataclass
 class Config:
     tables: list[TableConfig] = field(default_factory=list)
     device: str = "auto"           # auto | tpu | cpu
@@ -44,6 +63,7 @@ class Config:
     mesh_axes: list[str] = field(default_factory=lambda: ["data"])
     cache_budget_bytes: int = 1 << 30
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    distributed: DistributedConfig = field(default_factory=DistributedConfig)
     use_jit: bool = True
 
     @staticmethod
@@ -74,7 +94,36 @@ class Config:
                   "worker_timeout_s"):
             if k in cl:
                 setattr(cfg.cluster, k, cl[k])
+        ds = raw.get("distributed", {})
+        for k in ("enabled", "coordinator_address", "num_processes",
+                  "process_id", "local_device_ids"):
+            if k in ds:
+                setattr(cfg.distributed, k, ds[k])
         return cfg
+
+
+def init_distributed(cfg: "Config") -> bool:
+    """Join the pod-spanning JAX runtime described by [distributed]; returns
+    True when initialization ran. Safe to call unconditionally — a disabled
+    section is a no-op, and TPU pod slices can omit every field
+    (jax.distributed auto-detects coordinator/process ids from the TPU
+    metadata server). After this, `jax.devices()` is GLOBAL and
+    `QueryEngine(mesh=...)` meshes span hosts (docs/distributed.md)."""
+    d = cfg.distributed
+    if not d.enabled:
+        return False
+    import jax
+    kw = {}
+    if d.coordinator_address is not None:
+        kw["coordinator_address"] = d.coordinator_address
+    if d.num_processes is not None:
+        kw["num_processes"] = d.num_processes
+    if d.process_id is not None:
+        kw["process_id"] = d.process_id
+    if d.local_device_ids is not None:
+        kw["local_device_ids"] = d.local_device_ids
+    jax.distributed.initialize(**kw)
+    return True
 
 
 def make_provider(t: TableConfig):

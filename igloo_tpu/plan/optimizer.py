@@ -33,7 +33,165 @@ def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
     plan = fold_constants_pass(plan)
     plan = reorder_cross_joins(plan)
     plan = pushdown_filters(plan)
+    plan = semi_join_reduction(plan)
     plan = prune_projections(plan)
+    return plan
+
+
+# --- magic-set / semi-join reduction ---------------------------------------
+
+# a key-source subtree must scan at most this much to be cloned as the
+# semi-join build side; the aggregate input must scan at least this much for
+# the rewrite to pay off
+_SEMI_BUILD_MAX_BYTES = 64 << 20
+_SEMI_INPUT_MIN_BYTES = 64 << 20
+
+
+def _est_scan_bytes(p: L.LogicalPlan, include_subqueries: bool = False
+                    ) -> Optional[int]:
+    """Total estimated source bytes under `p`; None when any scan is
+    unsized. With `include_subqueries`, plans embedded in expression
+    subqueries count too (the engine's host-routing cap uses this: a tiny
+    outer query over a subquery on a huge table must not land on the host)."""
+    from igloo_tpu.exec.chunked import estimated_bytes
+    total = 0
+    for n in L.walk_plan(p):
+        if isinstance(n, L.Scan):
+            if n.provider is None:
+                continue
+            nb = estimated_bytes(n.provider)
+            if nb is None:
+                return None
+            total += nb
+        if not include_subqueries:
+            continue
+        for e in _node_exprs(n):
+            stack = [e]
+            while stack:
+                x = stack.pop()
+                sub = getattr(x, "query", None)
+                if isinstance(sub, L.LogicalPlan):
+                    st = _est_scan_bytes(sub, include_subqueries=True)
+                    if st is None:
+                        return None
+                    total += st
+                stack.extend(x.children())
+    return total
+
+
+def _key_source(p: L.LogicalPlan, idx: int):
+    """Trace output column `idx` of `p` to an UNDER-filtered source subtree:
+    the subtree's values for that column are a SUPERSET of the values `p` can
+    produce (filters/joins above only drop rows), which is exactly what a
+    semi-join build side needs. Returns (subtree, col idx) or (None, 0)."""
+    if isinstance(p, L.Filter):
+        sub, si = _key_source(p.input, idx)
+        if sub is p.input and si == idx:
+            return p, idx  # nothing was cut below: keep the filter (tighter)
+        return sub, si
+    if isinstance(p, L.Project):
+        e = p.exprs[idx]
+        if isinstance(e, E.Alias):
+            e = e.operand
+        if not isinstance(e, E.Column):
+            return None, 0
+        sub, si = _key_source(p.input, e.index)
+        if sub is p.input and si == e.index:
+            return p, idx  # keep the projection node (schema stays aligned)
+        return sub, si
+    if isinstance(p, L.Join):
+        lw = len(p.left.schema)
+        if idx < lw and p.join_type in (JoinType.INNER, JoinType.CROSS,
+                                        JoinType.LEFT, JoinType.SEMI,
+                                        JoinType.ANTI):
+            return _key_source(p.left, idx)
+        if idx >= lw and p.join_type in (JoinType.INNER, JoinType.CROSS,
+                                        JoinType.LEFT):
+            # right side of a LEFT join adds NULL padding only; null keys
+            # never equi-match, so the unpadded source is still a superset
+            # of the matchable values
+            return _key_source(p.right, idx - lw)
+        return None, 0
+    return p, idx
+
+
+def semi_join_reduction(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Magic-set rewrite: Join(OUTER-SIDE, Aggregate-by-k) where the join key
+    on the outer side traces to a SMALL subtree -> filter the aggregate's
+    input with a semi join against that subtree's distinct keys.
+
+    TPC-H q17 is the canonical case: the decorrelated per-part average
+    aggregates ALL 6M lineitem rows into 200k groups, but the outer query
+    joins the result against ~200 filtered parts — aggregating the other
+    199,800 groups is pure waste (and on the static-shape device path, the
+    full-width aggregate dominates the query). The reference has no analog
+    (DataFusion's optimizer lacks magic sets too); the rewrite matters here
+    because TPU aggregation cost scales with padded input lanes.
+
+    Correctness: the semi join drops whole groups whose key is outside the
+    source's key SUPERSET — groups that could never equi-match the outer
+    side (null group keys included: null never equi-matches). Rows within
+    retained groups are untouched, so aggregate values are identical."""
+    for name in ("input", "left", "right"):
+        ch = getattr(plan, name, None)
+        if isinstance(ch, L.LogicalPlan):
+            setattr(plan, name, semi_join_reduction(ch))
+    if isinstance(plan, L.Union):
+        plan.inputs = [semi_join_reduction(c) for c in plan.inputs]
+    if not (isinstance(plan, L.Join) and
+            plan.join_type in (JoinType.INNER, JoinType.LEFT, JoinType.SEMI)
+            and len(plan.left_keys) == 1 and
+            isinstance(plan.left_keys[0], E.Column) and
+            isinstance(plan.right_keys[0], E.Column)):
+        return plan
+    # locate an Aggregate under identity projections on the right, with the
+    # join key landing on one of its GROUP columns
+    node, idx = plan.right, plan.right_keys[0].index
+    while isinstance(node, L.Project):
+        e = node.exprs[idx]
+        if isinstance(e, E.Alias):
+            e = e.operand
+        if not isinstance(e, E.Column):
+            return plan
+        node, idx = node.input, e.index
+    if not isinstance(node, L.Aggregate) or idx >= len(node.group_exprs):
+        return plan
+    if isinstance(node.input, L.Join) and \
+            node.input.join_type is JoinType.SEMI:
+        return plan  # already reduced
+    in_bytes = _est_scan_bytes(node.input)
+    if in_bytes is None or in_bytes < _SEMI_INPUT_MIN_BYTES:
+        return plan
+    src, src_idx = _key_source(plan.left, plan.left_keys[0].index)
+    if src is None:
+        return plan
+    # the source must be SELECTIVE: an unfiltered base table as the build
+    # side filters nothing (FK integrity makes every group survive) and its
+    # distinct-keys subplan is pure cost — e.g. q18's o_orderkey IN (...)
+    # traces to the bare orders scan and must NOT rewrite
+    if not any(isinstance(n, L.Filter) or
+               (isinstance(n, L.Scan) and n.pushed_filters)
+               for n in L.walk_plan(src)):
+        return plan
+    sb = _est_scan_bytes(src)
+    if sb is None or sb > _SEMI_BUILD_MAX_BYTES:
+        return plan
+    gk = node.group_exprs[idx]
+    f = src.schema.fields[src_idx]
+    if gk.dtype != f.dtype:
+        return plan
+    col = E.Column(f.name, index=src_idx)
+    col.dtype = f.dtype
+    proj = L.Project(input=L.copy_plan(src), exprs=[col], names=[f.name])
+    proj.schema = T.Schema([f])
+    dist = L.Distinct(input=proj)
+    dist.schema = proj.schema
+    bcol = E.Column(f.name, index=0)
+    bcol.dtype = f.dtype
+    semi = L.Join(left=node.input, right=dist, join_type=JoinType.SEMI,
+                  left_keys=[copy.deepcopy(gk)], right_keys=[bcol])
+    semi.schema = node.input.schema
+    node.input = semi
     return plan
 
 

@@ -1,0 +1,84 @@
+"""[distributed] config section -> jax.distributed.initialize plumbing
+(docs/distributed.md; SURVEY #20 "jax distributed init")."""
+import jax
+import pytest
+
+from igloo_tpu.config import Config, DistributedConfig, init_distributed
+
+
+def test_disabled_is_noop():
+    cfg = Config()
+    assert cfg.distributed.enabled is False
+    assert init_distributed(cfg) is False
+
+
+def test_toml_roundtrip(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text("""
+[distributed]
+enabled = true
+coordinator_address = "10.0.0.1:8476"
+num_processes = 4
+process_id = 2
+local_device_ids = [0, 1]
+
+[engine]
+mesh_shape = [32]
+""")
+    cfg = Config.load(str(p))
+    d = cfg.distributed
+    assert d.enabled and d.coordinator_address == "10.0.0.1:8476"
+    assert d.num_processes == 4 and d.process_id == 2
+    assert d.local_device_ids == [0, 1]
+    assert cfg.mesh_shape == [32]
+
+
+def test_initialize_args_forwarded(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: seen.update(kw))
+    cfg = Config()
+    cfg.distributed = DistributedConfig(
+        enabled=True, coordinator_address="h:1", num_processes=2,
+        process_id=1)
+    assert init_distributed(cfg) is True
+    assert seen == {"coordinator_address": "h:1", "num_processes": 2,
+                    "process_id": 1}
+
+
+def test_autodetect_passes_no_args(monkeypatch):
+    """TPU pod slices auto-detect everything from the metadata server."""
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    cfg = Config()
+    cfg.distributed = DistributedConfig(enabled=True)
+    assert init_distributed(cfg) is True
+    assert calls == [{}]
+
+
+def test_cli_engine_initializes_distributed(monkeypatch, tmp_path):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    p = tmp_path / "cfg.toml"
+    p.write_text("[distributed]\nenabled = true\n"
+                 "coordinator_address = \"h:1\"\n"
+                 "num_processes = 1\nprocess_id = 0\n")
+    from igloo_tpu.cli import build_engine
+    from igloo_tpu.config import Config as C
+    build_engine(C.load(str(p)))
+    assert calls and calls[0]["coordinator_address"] == "h:1"
+
+
+def test_unknown_keys_ignored(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text("[distributed]\nenabled = false\nfuture_knob = 1\n")
+    cfg = Config.load(str(p))
+    assert cfg.distributed.enabled is False
+
+
+@pytest.mark.parametrize("field", ["coordinator_address", "num_processes",
+                                   "process_id", "local_device_ids"])
+def test_defaults_none(field):
+    assert getattr(DistributedConfig(), field) is None
