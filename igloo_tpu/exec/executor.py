@@ -50,6 +50,14 @@ from igloo_tpu.utils import tracing
 
 _SHRINK_FACTOR = 4  # shrink a batch when capacity > factor * needed
 
+import os as _os  # noqa: E402
+
+# print each first-in-process program build (kind + fingerprint) to stderr:
+# the last line before a hang names the program whose XLA compile is
+# pathological (compiles run server-side on tunneled TPUs — local profiling
+# sees only an idle wait)
+_LOG_COMPILES = _os.environ.get("IGLOO_TPU_LOG_COMPILES", "") == "1"
+
 _SENTINEL = object()  # "use the plan's projection" marker for read_scan_table
 
 
@@ -155,6 +163,12 @@ class Executor:
         fn = self._cache.get(key)
         if fn is None:
             tracing.counter("jit.miss")
+            if _LOG_COMPILES:
+                import sys
+                print(f"igloo-compile: {kind} "
+                      f"{hash(repr(fingerprint)) & 0xFFFFFFFF:08x} "
+                      f"{repr(fingerprint)[:160]}",
+                      file=sys.stderr, flush=True)
             fn = build()
             if self._use_jit:
                 fn = jax.jit(fn, static_argnums=static_argnums)
@@ -531,7 +545,7 @@ class Executor:
     # --- blocking ops ---
 
     def _exec_aggregate(self, plan: L.Aggregate) -> DeviceBatch:
-        batch = self._exec(plan.input)
+        batch = self._adaptive_input(self._exec(plan.input), plan.input)
         distinct_aggs = [a for a in plan.aggs if a.distinct]
         if distinct_aggs:
             return self._exec_distinct_aggregate(plan, batch)
@@ -694,7 +708,7 @@ class Executor:
         return DeviceBatch(plan.schema, cols, merged.live)
 
     def _exec_distinct(self, plan: L.Distinct) -> DeviceBatch:
-        batch = self._exec(plan.input)
+        batch = self._adaptive_input(self._exec(plan.input), plan.input)
         fp = ("distinct", batch_proto_key(batch))
 
         def build():
@@ -703,9 +717,59 @@ class Executor:
         out = attach_dicts(out, *col_meta(batch.columns))
         return self._maybe_shrink(out)
 
+    def _adaptive_input(self, batch: DeviceBatch,
+                        plan_node: L.LogicalPlan) -> DeviceBatch:
+        """Bound a join input's CAPACITY before the probe program compiles:
+        XLA compile time on the sorted-probe join grows pathologically with
+        lane count (observed: a 2x8.4M-lane probe+expand never finished in
+        25 min, while 8.4Mx64 compiles in ~71 s — q18/q21 at SF1), so a side
+        whose live count is far below its padded capacity must compact first.
+        The live count comes from a persisted per-subtree hint; its first
+        observation costs ONE sync, after which dense inputs skip even that
+        and sparse ones compact IN-PROGRAM with a deferred overflow flag
+        (exact re-run on staleness)."""
+        cap = batch.capacity
+        if cap <= self._SPECULATIVE_JOIN_BUDGET or not self._speculate \
+                or self._use_jit is False:
+            return batch
+        from igloo_tpu.exec.host import HostExecutor
+        fp = HostExecutor._plan_fp(plan_node)
+        if fp is None:
+            return self._maybe_shrink(batch)
+        # capacity IS part of this key: an input subtree's capacity comes
+        # from its scans (stable run-to-run for the same data), so including
+        # it cannot cascade — and it keeps sf1/sf10 executions of the same
+        # exprs from sharing live counts (a stale cross-scale hint would
+        # force an exact re-run whose unshrunk probes compile pathologically)
+        key = ("slive", fp, batch.capacity)
+        hint = self._staged_hint(key)
+        if hint is None:
+            n = batch.num_live()  # one sync, first sight of this subtree only
+            self._cache[("nhint", key)] = n
+            if self._hints is not None:
+                self._hints.put(key, n)
+                self._hints.flush()
+            return self._maybe_shrink(batch, known_live=n)
+        want = round_capacity(max(hint, 1))
+        if want * _SHRINK_FACTOR > cap:
+            return batch  # dense input: leave as-is, no sync
+        jfp = ("acompact_in", batch_proto_key(batch), want)
+
+        def build():
+            def fn(b):
+                n = jnp.sum(b.live.astype(jnp.int64))
+                return K.compact_to(b, want), n, n > want
+            return fn
+        out, n_dev, ovf = self._jitted("acompact_in", jfp, build)(
+            strip_dicts(batch))
+        self._deferred_stats.append((key, n_dev))
+        self._deferred_overflow.append((("scompact", key), ovf))
+        tracing.counter("join.input_compact")
+        return attach_dicts(out, *col_meta(batch.columns))
+
     def _exec_join(self, plan: L.Join) -> DeviceBatch:
-        left = self._exec(plan.left)
-        right = self._exec(plan.right)
+        left = self._adaptive_input(self._exec(plan.left), plan.left)
+        right = self._adaptive_input(self._exec(plan.right), plan.right)
         pool = ConstPool()
         compL = ExprCompiler([c.dictionary for c in left.columns], pool,
                      bounds=[c.bounds for c in left.columns])
@@ -811,6 +875,31 @@ class Executor:
                 return attach_dicts(out, dicts[: len(out.columns)],
                                     bnds[: len(out.columns)])
 
+        if jt in (JoinType.SEMI, JoinType.ANTI) and use_lk and \
+                self._speculate:
+            from igloo_tpu.exec.join import semi_anti_phase
+            # windowed sorted membership (no expansion). With a residual the
+            # window must cover the build side's duplicate-key runs (TPC-H:
+            # <= 7 lineitems per order); a truncated run raises the deferred
+            # flag -> exact re-run via _exact_copy (which takes the expand
+            # path: correct, possibly slow — the flag is data-dependent and
+            # rare by construction)
+            win = 2 if residual is None else 12
+            fn = self._jitted(
+                "join_semi", fpbase + (win,),
+                lambda: (lambda l, r, consts: semi_anti_phase(
+                    l, r, use_lk, use_rk, lhx, rhx,
+                    jt is JoinType.ANTI, residual, win, consts)))
+            tracing.counter("join.semi_sorted")
+            out, truncated = fn(ls, rs, consts)
+            if residual is not None:
+                self._deferred_overflow.append(
+                    (("semi_window", fpbase), truncated))
+            # no shrink sync here: downstream consumers bound their own
+            # input capacities adaptively (_adaptive_input)
+            return attach_dicts(out, dicts[: len(out.columns)],
+                                bnds[: len(out.columns)])
+
         probe = self._jitted(
             "join_probe", fpbase,
             lambda: (lambda l, r, consts: probe_phase(
@@ -846,7 +935,7 @@ class Executor:
 
     def _exec_window(self, plan: L.Window) -> DeviceBatch:
         from igloo_tpu.exec.window import compile_window, window_batch
-        batch = self._exec(plan.input)
+        batch = self._adaptive_input(self._exec(plan.input), plan.input)
         comp = ExprCompiler([c.dictionary for c in batch.columns],
                             bounds=[c.bounds for c in batch.columns])
         wfp, pk, okeys, specs, wdicts, wbounds = compile_window(
@@ -867,7 +956,7 @@ class Executor:
 
     def _exec_sort(self, plan: L.Sort) -> DeviceBatch:
         from igloo_tpu.exec.expr_compile import rank_lane
-        batch = self._exec(plan.input)
+        batch = self._adaptive_input(self._exec(plan.input), plan.input)
         res, keys, comp = self._compile_exprs(plan.keys, batch)
         # ORDER BY over unsorted (high-cardinality) dictionaries sorts ranks
         keys = [rank_lane(k, comp) if k.dtype.is_string else k for k in keys]

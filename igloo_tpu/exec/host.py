@@ -1077,9 +1077,18 @@ class HostExecutor:
             else:
                 items.append(it.value)
         if c.dtype.is_string:
-            sv = _materialize_str(c)
-            out = np.isin(sv, np.asarray([str(i) for i in items], dtype=str)) \
-                if items else np.zeros(b.n, dtype=bool)
+            if c.dict is not None:
+                lut = np.isin(c.dict.values.astype(str),
+                              np.asarray([str(i) for i in items], dtype=str)) \
+                    if items and len(c.dict) else \
+                    np.zeros(max(len(c.dict), 1), dtype=bool)
+                out = lut[np.clip(c.values, 0, max(len(c.dict) - 1, 0))] \
+                    if len(c.dict) else np.zeros(b.n, dtype=bool)
+            else:
+                sv = _materialize_str(c)
+                out = np.isin(sv, np.asarray([str(i) for i in items],
+                                             dtype=str)) \
+                    if items else np.zeros(b.n, dtype=bool)
         else:
             out = np.isin(c.values,
                           np.asarray(items, dtype=c.values.dtype)) \
@@ -1185,38 +1194,54 @@ class HostExecutor:
                 if len(d) else np.zeros(b.n, np.int64)
             return HCol(T.INT64, out, c.nulls)
 
-        def transform(f: Callable[[str], str]) -> HCol:
-            new = np.asarray([f(str(v)) for v in d.values], dtype=object)
-            uniq, inverse = (np.unique(new.astype(str), return_inverse=True)
-                             if len(new) else (np.asarray([], dtype=str),
-                                               np.zeros(0, np.int64)))
-            nd = DictInfo.from_values(uniq.astype(object))
-            codes = inverse.astype(np.int32)[
-                np.clip(c.values, 0, max(len(d) - 1, 0))] \
+        def transform(f: Callable[[str], str], memo_key=None) -> HCol:
+            # per-entry transforms memoize on the (cached) DictInfo: the
+            # same substring/upper over the same column costs one python
+            # pass per PROCESS, not one per evaluation (q22 evaluates
+            # substr(c_phone,1,2) three times over a 150k-entry dictionary)
+            cache = getattr(d, "_xform_memo", None)
+            if cache is None:
+                cache = {}
+                object.__setattr__(d, "_xform_memo", cache)
+            hit = cache.get(memo_key) if memo_key is not None else None
+            if hit is None:
+                new = np.asarray([f(str(v)) for v in d.values], dtype=object)
+                uniq, inverse = (np.unique(new.astype(str),
+                                           return_inverse=True)
+                                 if len(new) else (np.asarray([], dtype=str),
+                                                   np.zeros(0, np.int64)))
+                nd = DictInfo.from_values(uniq.astype(object))
+                hit = (inverse.astype(np.int32), nd)
+                if memo_key is not None:
+                    cache[memo_key] = hit
+            inverse32, nd = hit
+            codes = inverse32[np.clip(c.values, 0, max(len(d) - 1, 0))] \
                 if len(d) else np.zeros(b.n, np.int32)
             return HCol(T.STRING, codes, c.nulls, nd)
 
         if name == "upper":
-            return transform(str.upper)
+            return transform(str.upper, memo_key=("upper",))
         if name == "lower":
-            return transform(str.lower)
+            return transform(str.lower, memo_key=("lower",))
         if name == "capitalize":
             # reference parity: crates/engine/src/lib.rs:71-95
             return transform(lambda s: (s[:1].upper() + s[1:].lower())
-                             if s else s)
+                             if s else s, memo_key=("capitalize",))
         if name == "trim":
-            return transform(str.strip)
+            return transform(str.strip, memo_key=("trim",))
         if name in ("substr", "substring"):
             start = lit_int(1)
             ln = lit_int(2, default=1 << 30)
             i0 = max(start - 1, 0)
-            return transform(lambda s: s[i0: i0 + ln])
+            return transform(lambda s: s[i0: i0 + ln],
+                             memo_key=("substr", i0, ln))
         if name == "left":
             ln = lit_int(1)
-            return transform(lambda s: s[:ln])
+            return transform(lambda s: s[:ln], memo_key=("left", ln))
         if name == "right":
             ln = lit_int(1)
-            return transform(lambda s: s[-ln:] if ln else "")
+            return transform(lambda s: s[-ln:] if ln else "",
+                             memo_key=("right", ln))
         if name == "concat":
             parts = [self._eval(a, b) for a in e.args]
             svals = [_materialize_str(p) if p.dtype.is_string

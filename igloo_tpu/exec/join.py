@@ -203,6 +203,86 @@ def _any_null(lanes: list[_KeyLanes], cap) -> jax.Array:
     return out
 
 
+def semi_anti_phase(left: DeviceBatch, right: DeviceBatch,
+                    left_keys: list, right_keys: list,
+                    lhx: list, rhx: list, anti: bool,
+                    residual: Optional[Compiled] = None,
+                    window: int = 2, consts: tuple = ()):
+    """SEMI/ANTI without candidate expansion: membership is a sorted search
+    over the build side's combined key hash with EXACT verify-lane equality
+    at a `window`-slot run. The expand program (scatter-max ownership +
+    associative scan + full-width gathers) hangs XLA's server-side compiler
+    at multi-million-lane match capacities (observed: 25+ min on TPC-H q18's
+    semi at SF1); this shape is a sort + searchsorted + a handful of gathers,
+    and SEMI/ANTI only ever need a per-left-row boolean anyway.
+
+    Without a residual the window only covers hash collisions (2 slots).
+    With one (EXISTS ... AND extra-condition, e.g. q21), every candidate in
+    the key's duplicate run must be tested: the window widens and a
+    `truncated` flag reports any left row whose run may extend past it —
+    the caller re-runs exactly (deferred overflow protocol).
+
+    Returns (DeviceBatch, truncated flag)."""
+    l_lanes = _key_lanes(left, left_keys, lhx, consts)
+    r_lanes = _key_lanes(right, right_keys, rhx, consts)
+
+    def combined(lanes, live):
+        flat, nulls = [], []
+        valid = live
+        for kl in lanes:
+            for ln in kl.hash_ints:
+                flat.append(ln.astype(jnp.int64))
+                nulls.append(kl.null)
+            if kl.null is not None:
+                valid = valid & ~kl.null  # null keys never equi-match
+        return K.hash_lanes(flat, nulls), valid
+
+    lh, lvalid = combined(l_lanes, left.live)
+    rh, rvalid = combined(r_lanes, right.live)
+    big = jnp.int64(0x7FFFFFFFFFFFFFFF)
+    rmasked = jnp.where(rvalid, rh, big)
+    order = jnp.argsort(rmasked)
+    rsorted = jnp.take(rmasked, order)
+    rv_sorted = jnp.take(rvalid, order)
+    r_eq = [jnp.take(ln.astype(jnp.int64), order)
+            for kl in r_lanes for ln in kl.eq_lanes]
+    l_eq = [ln.astype(jnp.int64) for kl in l_lanes for ln in kl.eq_lanes]
+    lo = jnp.searchsorted(rsorted, lh)
+    cap_r = right.capacity
+    member = jnp.zeros(left.capacity, dtype=bool)
+    truncated = jnp.asarray(False)
+    last_keyeq = None
+    for off in range(window):
+        j = jnp.clip(lo + off, 0, cap_r - 1)
+        keyeq = jnp.take(rv_sorted, j)
+        for le, re_ in zip(l_eq, r_eq):
+            keyeq = keyeq & (le == jnp.take(re_, j))
+        ok = keyeq
+        if residual is not None:
+            ridx = jnp.take(order, j)
+            r_vals = [jnp.take(c.values, ridx) for c in right.columns]
+            r_nulls = [jnp.take(c.nulls, ridx) if c.nulls is not None
+                       else None for c in right.columns]
+            env = Env([c.values for c in left.columns] + r_vals,
+                      [c.nulls for c in left.columns] + r_nulls, consts)
+            rv, rn = residual.fn(env)
+            ok = ok & rv
+            if rn is not None:
+                ok = ok & ~rn
+        member = member | ok
+        last_keyeq = keyeq
+    if residual is not None and last_keyeq is not None:
+        # a key-equal candidate at the FINAL slot means the duplicate run may
+        # continue beyond the window for that row: unverified candidates
+        # could flip membership — flag for an exact re-run
+        # rows NOT yet matched whose run may continue: more candidates could
+        # flip them to matched (changing SEMI keeps and ANTI drops alike)
+        truncated = jnp.any(last_keyeq & lvalid & left.live & ~member)
+    member = member & lvalid
+    keep = left.live & (~member if anti else member)
+    return DeviceBatch(left.schema, left.columns, keep), truncated
+
+
 def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
                  match_cap: int, join_type: JoinType,
                  residual: Optional[Compiled],

@@ -126,6 +126,13 @@ class ShardedExecutor(Executor):
             return replicate(batch, self.mesh)
         return batch
 
+    def _adaptive_input(self, batch: DeviceBatch, plan_node) -> DeviceBatch:
+        # row-sharded joins bound their capacities via the shuffle buckets;
+        # cross-shard compaction here would be an extra collective
+        if is_row_sharded(batch):
+            return batch
+        return super()._adaptive_input(batch, plan_node)
+
     def _exec_sort(self, plan: L.Sort) -> DeviceBatch:
         batch = self._exec(plan.input)
         if (not is_row_sharded(batch) or self.n_dev <= 1
