@@ -22,6 +22,7 @@ from igloo_tpu.types import Schema
 
 class CsvTable:
     stable_row_order = True  # deterministic file order + sequential parse
+    bytes_expansion = 1.5    # text numbers re-encode to comparable lane bytes
 
     def __deepcopy__(self, memo):
         # providers are shared by plan/expression copies (see copy_plan)

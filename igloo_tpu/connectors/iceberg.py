@@ -36,6 +36,7 @@ _CONTENT_DATA = 0
 
 class IcebergTable:
     stable_row_order = True  # manifest-ordered data files, deterministic
+    bytes_expansion = 3.5    # parquet data files, as ParquetTable
 
     def __deepcopy__(self, memo):
         # providers are shared by plan/expression copies (see copy_plan)

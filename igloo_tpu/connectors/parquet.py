@@ -27,6 +27,9 @@ class ParquetTable:
 
     # deterministic file/row-group order -> scans may be cached per column
     stable_row_order = True
+    # compressed columnar files decode to ~3-4x their size as int64/float64
+    # device lanes (device-memory budgets scale estimates by this)
+    bytes_expansion = 3.5
 
     def __init__(self, path: str):
         import threading

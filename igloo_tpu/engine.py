@@ -72,8 +72,14 @@ class QueryEngine:
         self.udfs: dict[str, UdfDef] = {}
         self._jit_cache: dict = {}
         self._use_jit = use_jit
-        # source tables whose estimated size exceeds this execute partition-
-        # at-a-time (exec/chunked.py) instead of as one DeviceBatch
+        # source tables whose estimated DEVICE-LANE size exceeds this
+        # execute partition-at-a-time (exec/chunked.py) or via
+        # GRACE-partitioned joins (exec/grace.py) instead of as one
+        # DeviceBatch. Comparisons use estimated_lane_bytes (file estimates
+        # x the provider's bytes_expansion): SF10's 1.2 GB parquet lineitem
+        # decodes to ~4 GB of int64/float64 lanes, and its full-width join
+        # intermediates at 67M lanes crash a 16 GB-HBM chip if run
+        # monolithically
         self.chunk_budget_bytes = chunk_budget_bytes
         # multi-chip execution: "auto" = row-shard across all local devices
         # when more than one is visible (parallel/ShardedExecutor); None =

@@ -41,6 +41,18 @@ def estimated_bytes(provider) -> Optional[int]:
     return None
 
 
+def estimated_lane_bytes(provider) -> Optional[int]:
+    """Estimated size once decoded to device lanes: the raw estimate times
+    the provider's `bytes_expansion` (compressed parquet decodes to ~3-4x
+    its file size as int64/float64 lanes; in-memory Arrow tables report
+    decoded bytes already, factor 1). Device-memory budget checks must use
+    THIS, not file bytes."""
+    nb = estimated_bytes(provider)
+    if nb is None:
+        return None
+    return int(nb * getattr(provider, "bytes_expansion", 1.0))
+
+
 def chunk_count(plan: L.LogicalPlan, budget_bytes: int) -> int:
     """How many chunks the largest over-budget scanned table needs (0 = no
     chunking). Only scans that the fragment planner can actually stream —
@@ -57,7 +69,7 @@ def chunk_count(plan: L.LogicalPlan, budget_bytes: int) -> int:
         for sc in L.walk_plan(node.input):
             if isinstance(sc, L.Scan) and sc.provider is not None and \
                     sc.partition is None:
-                nbytes = estimated_bytes(sc.provider)
+                nbytes = estimated_lane_bytes(sc.provider)
                 try:
                     parts = sc.provider.num_partitions()
                 except Exception:

@@ -50,7 +50,7 @@ def find_grace_join(plan: L.LogicalPlan, budget_bytes: int):
     down to (excluding) the join, and `agg` the decomposable Aggregate on the
     path (or None); None when the plan doesn't qualify."""
     from igloo_tpu.cluster.fragment import _DECOMPOSABLE
-    from igloo_tpu.exec.chunked import estimated_bytes
+    from igloo_tpu.exec.chunked import estimated_lane_bytes
     path: list[L.LogicalPlan] = []
     node = plan
     agg: Optional[L.Aggregate] = None
@@ -81,7 +81,7 @@ def find_grace_join(plan: L.LogicalPlan, budget_bytes: int):
     over = False
     for sc in L.walk_plan(node):
         if isinstance(sc, L.Scan) and sc.provider is not None:
-            b = estimated_bytes(sc.provider)
+            b = estimated_lane_bytes(sc.provider)
             if b is not None:
                 total += b
                 if b > budget_bytes:
