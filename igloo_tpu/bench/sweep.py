@@ -36,23 +36,31 @@ def log(msg: str) -> None:
 from igloo_tpu.bench.runner import make_engine  # shared staging helper
 
 
+_CONVERGENCE_COUNTERS = ("jit.miss", "fused.compact_repair",
+                         "join.speculation_overflow",
+                         "join.direct_dup_fallback")
+
+
 def run_query(engine, sql: str, trials: int) -> dict:
     """cold -> hint-adoption re-runs -> warm trials -> result-cached run."""
+    from igloo_tpu.utils import tracing
     t0 = time.perf_counter()
     engine.execute(sql)
     cold = time.perf_counter() - t0
-    # adopt cardinality hints (one recompile each) until run time stabilizes;
-    # with the persistent hint store this loop is a no-op after the first-ever
-    # sweep (the process starts on the hinted program)
-    prev = cold
-    for _ in range(3):
+    # adopt cardinality hints until the EXECUTION converges: no fresh
+    # compiles and no repair/fallback re-runs. Judging by run TIME plateaus
+    # (the old loop) breaks too early on queries whose adoption cascades a
+    # few rounds at similar cost (q7: three ~10 s adoption rounds before the
+    # 0.5 s steady state — the plateau heuristic bailed after one and the
+    # repairs then fired inside the timed warm trials as a 20x flap)
+    for _ in range(8):
+        snap = tracing.counters()
+        before = {k: snap.get(k, 0) for k in _CONVERGENCE_COUNTERS}
         engine.result_cache.clear()
-        t0 = time.perf_counter()
         engine.execute(sql)
-        cur = time.perf_counter() - t0
-        if cur > 0.5 * prev:
+        after = tracing.counters()
+        if all(after.get(k, 0) == before[k] for k in _CONVERGENCE_COUNTERS):
             break
-        prev = cur
     warm = []
     for _ in range(trials):
         engine.result_cache.clear()

@@ -51,35 +51,63 @@ def minmax_order_arg(func: AggFunc, arg: Optional[Compiled],
     return rank_lane(arg, comp)
 
 
-def seg_dims_for(groups: list[Compiled]) -> Optional[tuple[int, ...]]:
+_DENSE_INT_SEG_LIMIT = 1 << 23
+
+
+def seg_dims_for(groups: list[Compiled],
+                 n_aggs: Optional[int] = None,
+                 input_capacity: Optional[int] = None) -> Optional[tuple]:
     """If every group key is directly indexable — a dictionary-encoded string
-    (ids in [0, len)) or a boolean — return per-key bucket counts (+1 for the
-    NULL bucket). The aggregate then scatters straight into `prod(dims)`
-    segments instead of lex-sorting every input lane (the sort is O(n log n)
-    over the FULL batch capacity; Q1 groups 8M lanes into 6 buckets).
-    Host-side decision: callers must fold the result into their jit cache key
-    (dictionary LENGTH is content, not shape — two same-shape-bucket
-    dictionaries may differ in size)."""
+    (ids in [0, len)), a boolean, or (round 5) an integer-family column with
+    host-known dense bounds — return per-key (bucket count, offset) pairs
+    (+1 bucket for NULL). The aggregate then scatters straight into
+    `prod(dims)` segments instead of lex-sorting every input lane (the sort
+    is O(n log n) over the FULL batch capacity; Q1 groups 8M lanes into 6
+    buckets, and q18's sum-per-orderkey groups 8M lanes into 6M dense-int
+    segments — 1 scatter instead of a multi-lane sort).
+
+    Large dense-int segment spaces (> 2^16) are only worth one scatter per
+    aggregate, so they require `n_aggs` (callers that cannot bound the
+    scatter count — the sharded partial path — omit it and keep the small
+    limit). Host-side decision: callers must fold the result into their jit
+    cache key."""
     dims = []
     for g in groups:
         if g.dtype is T.BOOL:
-            dims.append(3)
+            dims.append((3, 0))
         elif g.dtype.is_string and g.out_dict is not None:
-            dims.append(len(g.out_dict.values) + 1)
+            dims.append((len(g.out_dict.values) + 1, 0))
+        elif (g.dtype.is_integer or g.dtype.is_temporal) and \
+                g.out_bounds is not None:
+            lo, hi = g.out_bounds
+            dims.append((int(hi) - int(lo) + 2, int(lo)))
         else:
             return None
     prod = 1
-    for d in dims:
+    for d, _off in dims:
         prod *= d
-    if not dims or prod > (1 << 16):
+    if not dims or prod <= 0:
         return None
+    if prod > (1 << 16):
+        # the big-segment branch trades one ~1s scatter per aggregate value
+        # for the multi-lane sort: only worth it when the scatter count is
+        # small (AVG = sum+count = 2 scatters) AND the segment space does not
+        # dwarf the batch (bounds are GLOBAL scan stats — a filtered 64K-lane
+        # batch grouping by a 6M-wide key must keep the sort path, not
+        # allocate 8M-segment outputs)
+        if n_aggs is None or n_aggs > 2 or prod > _DENSE_INT_SEG_LIMIT:
+            return None
+        if input_capacity is None or prod > 2 * input_capacity:
+            return None
     return tuple(dims)
 
 
 def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
                     aggs: list[AggSpec], out_schema: T.Schema,
                     consts: tuple = (),
-                    seg_dims: Optional[tuple[int, ...]] = None) -> DeviceBatch:
+                    seg_dims: Optional[tuple] = None) -> DeviceBatch:
+    # seg_dims entries are (bucket_count, value_offset) pairs — see
+    # seg_dims_for
     """Pure, jit-traceable: DeviceBatch -> DeviceBatch of one row per group.
     Output columns carry no dictionaries — the executor re-attaches them.
     `seg_dims` (from seg_dims_for, included in the caller's cache key) selects
@@ -317,38 +345,46 @@ def _global_aggregate(env: Env, aggs: list[AggSpec], out_schema: T.Schema,
 def _direct_aggregate(env: Env, groups: list[Compiled], gvals, gnulls,
                       aggs: list[AggSpec], out_schema: T.Schema,
                       live: jax.Array,
-                      seg_dims: tuple[int, ...]) -> DeviceBatch:
+                      seg_dims: tuple) -> DeviceBatch:  # ((count, offset), ...)
     """Direct-scatter grouping for small indexable keys (see seg_dims_for):
     segment id = mixed-radix combination of (NULL?0:key+1) digits. Skips the
     full-capacity lex sort; output capacity = padded segment count (small)."""
     from igloo_tpu.exec.batch import round_capacity
     cap = live.shape[0]
     prod = 1
-    for d in seg_dims:
+    for d, _off in seg_dims:
         prod *= d
     nseg = round_capacity(prod + 1)
     dead = nseg - 1  # dead rows land here; >= prod, never a real key combo
     seg = jnp.zeros((cap,), dtype=jnp.int32)
-    for v, nl, d in zip(gvals, gnulls, seg_dims):
-        comp = v.astype(jnp.int32) + 1
+    for v, nl, (d, off) in zip(gvals, gnulls, seg_dims):
+        comp = (v - off).astype(jnp.int32) + 1 if off else \
+            v.astype(jnp.int32) + 1
         if nl is not None:
             comp = jnp.where(nl, 0, comp)
         seg = seg * jnp.int32(d) + comp
     seg = jnp.where(live, seg, jnp.int32(dead))
 
-    pos = jnp.arange(cap, dtype=jnp.int32)
     counts = K.seg_sum(live.astype(jnp.int32), seg, nseg)
     group_mask = (counts > 0) & (jnp.arange(nseg) < prod)
-    first_pos = K.seg_min(jnp.where(live, pos, jnp.int32(cap)), seg, nseg)
-    first_pos = jnp.clip(first_pos, 0, cap - 1)
 
+    # group VALUES decode from the segment index (every seg_dims kind is a
+    # bijection of its digit): no first-occurrence seg_min scatter — at
+    # dense-int scale (6M segments over 8M lanes) each scatter is ~1 s on TPU
     out_cols: list[DeviceColumn] = []
-    for v, nl, g in zip(gvals, gnulls, groups):
-        sv = jnp.take(v, first_pos)
-        snl = jnp.take(nl, first_pos) if nl is not None else None
-        out_cols.append(DeviceColumn(g.dtype, sv.astype(g.dtype.device_dtype())
-                                     if sv.dtype != g.dtype.device_dtype() else sv,
-                                     snl, g.out_dict))
+    segid = jnp.arange(nseg, dtype=jnp.int64)
+    digits = []
+    rest = segid
+    for d, _off in reversed(seg_dims):
+        digits.append(rest % d)
+        rest = rest // d
+    digits.reverse()
+    for digit, (d, off), g, nl in zip(digits, seg_dims, groups, gnulls):
+        raw = jnp.clip(digit - 1, 0, d - 2) + off
+        out_cols.append(DeviceColumn(
+            g.dtype, raw.astype(g.dtype.device_dtype()),
+            (digit == 0) if nl is not None else None,
+            g.out_dict))
     for spec in aggs:
         out_cols.append(_reduce_one(spec, env, None, seg, live, cap, nseg))
 

@@ -568,7 +568,9 @@ class Executor:
                                  order_arg=minmax_order_arg(a.func, arg, comp)))
         # direct-scatter eligibility is dictionary-CONTENT-dependent (sizes),
         # so it must join the cache key, not just shape signatures
-        seg_dims = seg_dims_for(groups)
+        n_scatters = sum(2 if a.func is E.AggFunc.AVG else 1 for a in aggs)
+        seg_dims = seg_dims_for(groups, n_aggs=n_scatters,
+                                input_capacity=batch.capacity)
         fp = ("agg", expr_fingerprint(gres + ares),
               tuple((a.func, a.dtype) for a in aggs),
               batch_proto_key(batch), out_schema,
@@ -751,7 +753,11 @@ class Executor:
                 self._hints.flush()
             return self._maybe_shrink(batch, known_live=n)
         want = round_capacity(max(hint, 1))
-        if want * _SHRINK_FACTOR > cap:
+        # factor 2, not _SHRINK_FACTOR: past the compile budget every halving
+        # of padded lanes halves the consumer's whole-program cost — a 2M-live
+        # input in 8.4M lanes must not keep its 4x padding (q18's final
+        # aggregate sat exactly on the 4x boundary and ran full-width)
+        if want * 2 > cap:
             return batch  # dense input: leave as-is, no sync
         jfp = ("acompact_in", batch_proto_key(batch), want)
 

@@ -470,7 +470,11 @@ class FusedCompiler:
             specs.append(AggSpec(a.func, arg, a.dtype, out_dict,
                                  order_arg=minmax_order_arg(a.func, arg, comp)))
         self.marks.extend(comp.marks)
-        seg_dims = seg_dims_for(groups)
+        from igloo_tpu.plan.expr import AggFunc as _AF
+        n_scatters = sum(
+            2 if a.func is _AF.AVG else 1 for a in plan.aggs)
+        seg_dims = seg_dims_for(groups, n_aggs=n_scatters,
+                                input_capacity=meta.capacity)
         self._push(("agg", tuple(repr(e) for e in gres + ares),
                     tuple((a.func, a.dtype) for a in plan.aggs),
                     plan.schema, seg_dims))
@@ -484,7 +488,7 @@ class FusedCompiler:
             cap = MIN_CAPACITY
         elif seg_dims is not None:
             prod = 1
-            for d in seg_dims:
+            for d, _off in seg_dims:
                 prod *= d
             cap = round_capacity(prod + 1)
         else:

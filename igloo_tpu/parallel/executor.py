@@ -541,7 +541,7 @@ class ShardedExecutor(Executor):
         # sized, so shuffle buckets and final capacities shrink with them
         if sdims is not None:
             p = 1
-            for d in sdims:
+            for d, _off in sdims:
                 p *= d
             partial_cap = round_capacity(p + 1)
         else:
