@@ -25,8 +25,9 @@ Architecture (round-5 redesign, VERDICT.md "next round" #1-2):
   compile gets its worker killed, the query is poisoned, and a fresh worker
   resumes with the remaining queries. Whatever has completed when the deadline
   hits is emitted — this process ALWAYS prints its JSON line.
-- pandas baselines run in THIS process between worker status reads (the TPU
-  and the CPU work overlap).
+- pandas baselines run in THIS process strictly AFTER the sweep finishes
+  (overlapping them with the worker would perturb both sides' medians), and
+  each baseline is budget-gated against the remaining deadline.
 - The SF10 block runs only if the remaining budget fits its estimated cost.
 
 The reference publishes no numbers (BASELINE.md: roadmap TODO only) and its
@@ -231,6 +232,7 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
         block["queries"][q] = {
             "cold_s": rec["cold_s"], "warm_med_s": med, "warm_min_s": lo,
             "warm_max_s": hi, "cached_s": rec["cached_s"],
+            "packed": rec.get("packed", False),
             "rows_per_s": round(rps)}
         log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s "
             f"[{lo:.4f},{hi:.4f}] ({rps:,.0f} rows/s)")

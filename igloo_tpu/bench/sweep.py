@@ -40,10 +40,17 @@ _CONVERGENCE_COUNTERS = ("jit.miss", "fused.compact_repair",
                          "join.speculation_overflow",
                          "join.direct_dup_fallback")
 
+# packed-key fast-path adoption counters (exec/kernels.py planners via the
+# executor/fused compilers): any delta across a query's runs means the
+# single-sort packed path was active for it, recorded per query so BENCH
+# rounds can attribute wins to that path
+_PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
+
 
 def run_query(engine, sql: str, trials: int) -> dict:
     """cold -> hint-adoption re-runs -> warm trials -> result-cached run."""
     from igloo_tpu.utils import tracing
+    pack_before = {k: tracing.counters().get(k, 0) for k in _PACK_COUNTERS}
     t0 = time.perf_counter()
     engine.execute(sql)
     cold = time.perf_counter() - t0
@@ -70,9 +77,12 @@ def run_query(engine, sql: str, trials: int) -> dict:
     t0 = time.perf_counter()
     engine.execute(sql)
     cached = time.perf_counter() - t0
+    pack_after = tracing.counters()
     return {"cold_s": round(cold, 4),
             "warm_trials": [round(w, 4) for w in warm],
-            "cached_s": round(cached, 4)}
+            "cached_s": round(cached, 4),
+            "packed": any(pack_after.get(k, 0) > pack_before[k]
+                          for k in _PACK_COUNTERS)}
 
 
 def main(argv=None) -> int:

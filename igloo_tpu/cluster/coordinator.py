@@ -466,8 +466,16 @@ class CoordinatorServer(flight.FlightServerBase):
         uploaded = None
         try:
             uploaded = reader.read_all()
-        except Exception:
-            uploaded = None  # client opened write-less exchange
+        except OSError as ex:
+            # pyarrow raises ArrowIOError "Client never sent a data message"
+            # for a write-less exchange — the one condition where echoing the
+            # stored table is the contract. Anything else is a real upload
+            # failure and must NOT be masked as a successful-looking echo.
+            if "never sent a data message" not in str(ex):
+                raise flight.FlightServerError(f"exchange upload failed: {ex}")
+        except Exception as ex:
+            # mid-stream decode/transport failure: surface it to the client
+            raise flight.FlightServerError(f"exchange upload failed: {ex}")
         if uploaded is not None and uploaded.num_rows > 0:
             self.register_table(name, uploaded)
         try:

@@ -253,6 +253,11 @@ class QueryEngine:
                 tracing.counter("engine.host_route_unsupported")
                 tracing.counter(
                     f"engine.host_route_unsupported.{e.args[0] if e.args else ''}")
+            except MemoryError:
+                # a host-tier allocation blowup (e.g. a grouped cardinality
+                # the direct-slot guards missed) must degrade to the device
+                # tier, not fail the query
+                tracing.counter("engine.host_route_oom")
         mesh = self._resolve_mesh()
         chunks = 0 if mesh is not None else \
             chunk_count(plan, self.chunk_budget_bytes)

@@ -105,14 +105,21 @@ def seg_dims_for(groups: list[Compiled],
 def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
                     aggs: list[AggSpec], out_schema: T.Schema,
                     consts: tuple = (),
-                    seg_dims: Optional[tuple] = None) -> DeviceBatch:
+                    seg_dims: Optional[tuple] = None,
+                    pack_spec: Optional[tuple] = None) -> DeviceBatch:
     # seg_dims entries are (bucket_count, value_offset) pairs — see
     # seg_dims_for
     """Pure, jit-traceable: DeviceBatch -> DeviceBatch of one row per group.
     Output columns carry no dictionaries — the executor re-attaches them.
     `seg_dims` (from seg_dims_for, included in the caller's cache key) selects
     the direct-scatter fast path; output capacity is then the padded segment
-    count, not the input capacity."""
+    count, not the input capacity. `pack_spec` (kernels.plan_group_packing,
+    also part of the caller's cache key) is (spec, packed key indices): the
+    indexed keys fuse into ONE int lane, collapsing their share of the
+    multi-lane lex_argsort chain to a single sort pass — when every key packs
+    (all-integer group-bys) the whole chain becomes one argsort, and a
+    q18-shaped 5-key group-by with one float key sorts 3 lanes instead of
+    10+."""
     env = Env.from_batch(batch, consts)
     cap = batch.capacity
     live = batch.live
@@ -132,22 +139,87 @@ def aggregate_batch(batch: DeviceBatch, groups: list[Compiled],
         return _direct_aggregate(env, groups, gvals, gnulls, aggs, out_schema,
                                  live, seg_dims)
 
-    # sort path: equality lanes (string ids are already ranks; floats
-    # decompose into nan-flag + normalized-value lanes — no 64-bit bitcasts,
-    # TPU-safe)
-    flat_lanes: list = []
-    flat_nulls: list = []
-    sort_lanes: list = []
-    for v, nl, g in zip(gvals, gnulls, groups):
-        for lane in K.group_lanes_for(v, g.dtype.is_float):
-            flat_lanes.append(lane)
-            flat_nulls.append(nl)
-        sort_lanes.extend(K.sort_lanes_for(v, nl, g.dtype.is_float, True, False))
-    perm = K.lex_argsort(sort_lanes, live)
+    # sort path. With a pack_spec, the indexed keys fuse into ONE packed lane
+    # (NULL is a digit, so no separate null lanes for them). Grouping never
+    # cares about lane SIGNIFICANCE order — only equal-key adjacency — so any
+    # unpacked keys' null/NaN flags AND the live bit fold into the packed
+    # lane's spare high bits when they fit: a q18-shaped group-by (4 packable
+    # keys + 1 float) then sorts TWO lanes (float value, folded packed)
+    # instead of the 11-pass lex chain; an all-packed group-by sorts ONE.
+    packed = None
+    packed_idx: tuple = ()
+    rest: list = []
+    if pack_spec is not None:
+        spec, packed_idx = pack_spec
+        packed = K.pack_key_lane(spec, [gvals[i] for i in packed_idx],
+                                 [gnulls[i] for i in packed_idx], consts)
+        rest = [i for i in range(len(groups)) if i not in packed_idx]
+        pack_bits = sum(card.bit_length() - 1 for card, _, _ in spec[2])
+        n_flags = 1 + sum((1 if groups[i].dtype.is_float else 0) +
+                          (1 if gnulls[i] is not None else 0) for i in rest)
+    if packed is not None and not rest:
+        # every key packed: one argsort (dead rows via the packed sentinel)
+        perm = jnp.argsort(K.packed_sort_key(packed, live), stable=True)
+        s_lanes, s_nulls = [jnp.take(packed, perm)], [None]
+    elif packed is not None and pack_bits + n_flags <= 63:
+        # folded mixed path: value lanes (null-masked; floats NaN-normalized)
+        # sort first, the folded lane [dead | flags | packed digits] sorts
+        # last — its dead bit replaces lex_argsort's trailing live pass
+        lane = packed.astype(jnp.int64)
+        shift = pack_bits
+        value_lanes: list = []
+        for i in rest:
+            v, nl, g = gvals[i], gnulls[i], groups[i]
+            if nl is not None:
+                # mask BEFORE deriving the NaN flag: this branch compares raw
+                # lanes with no null awareness (s_nulls is all-None), so
+                # under-null storage — which may be NaN on one row and finite
+                # on another — must collapse to one canonical value or the
+                # NULL group would split
+                v = jnp.where(nl, jnp.zeros((), v.dtype), v)
+            if g.dtype.is_float:
+                vnorm, nan = K.normalize_float(v)
+                lane = lane + (nan.astype(jnp.int64) << shift)
+                shift += 1
+                v = vnorm
+            if nl is not None:
+                lane = lane + (nl.astype(jnp.int64) << shift)
+                shift += 1
+            value_lanes.append(v)
+        lane = lane + ((~live).astype(jnp.int64) << shift)
+        shift += 1
+        if shift <= 31:
+            lane = lane.astype(jnp.int32)
+        perm = jnp.arange(cap, dtype=jnp.int32)
+        for v in reversed(value_lanes):
+            perm = jnp.take(perm,
+                            jnp.argsort(jnp.take(v, perm), stable=True))
+        perm = jnp.take(perm,
+                        jnp.argsort(jnp.take(lane, perm), stable=True))
+        s_lanes = [jnp.take(lane, perm)] + \
+            [jnp.take(v, perm) for v in value_lanes]
+        s_nulls = [None] * len(s_lanes)
+    else:
+        # lex chain over the unpacked keys — equality lanes (string ids are
+        # already ranks; floats decompose into nan-flag + normalized-value
+        # lanes, no 64-bit bitcasts, TPU-safe) — led by the packed lane when
+        # one exists (subset pack whose fold flags overflowed the spare bits)
+        flat_lanes: list = [packed] if packed is not None else []
+        flat_nulls: list = [None] if packed is not None else []
+        sort_lanes: list = [(packed, True)] if packed is not None else []
+        for i, (v, nl, g) in enumerate(zip(gvals, gnulls, groups)):
+            if i in packed_idx:
+                continue
+            for eq in K.group_lanes_for(v, g.dtype.is_float):
+                flat_lanes.append(eq)
+                flat_nulls.append(nl)
+            sort_lanes.extend(K.sort_lanes_for(v, nl, g.dtype.is_float,
+                                               True, False))
+        perm = K.lex_argsort(sort_lanes, live)
+        s_lanes = [jnp.take(l, perm) for l in flat_lanes]
+        s_nulls = [jnp.take(nl, perm) if nl is not None else None
+                   for nl in flat_nulls]
     s_live = jnp.take(live, perm)
-    s_lanes = [jnp.take(l, perm) for l in flat_lanes]
-    s_nulls = [jnp.take(nl, perm) if nl is not None else None
-               for nl in flat_nulls]
     seg, start = K.group_segments(s_lanes, s_nulls, s_live)
     num_groups = jnp.sum(start.astype(jnp.int32))
 

@@ -387,6 +387,21 @@ class Executor:
     # --- leaves ---
 
     def _exec_scan(self, plan: L.Scan) -> DeviceBatch:
+        batch = self._scan_batch(plan)
+        # provider-pinned bounds (GRACE partitions: the UNION range across all
+        # partitions) replace per-read exact bounds so every partition keys
+        # the same compiled programs; a superset range is always safe for the
+        # consumers (direct-join table sizing, packed-key radices)
+        fixed = getattr(plan.provider, "fixed_bounds", None) \
+            if plan.provider is not None else None
+        if fixed:
+            from dataclasses import replace
+            cols = [replace(c, bounds=fixed.get(f.name, c.bounds))
+                    for f, c in zip(batch.schema, batch.columns)]
+            batch = DeviceBatch(batch.schema, cols, batch.live)
+        return batch
+
+    def _scan_batch(self, plan: L.Scan) -> DeviceBatch:
         stable = getattr(plan.provider, "stable_row_order", False)
         if self._batch_cache is None or not stable:
             # whole-batch path: providers without deterministic row order
@@ -571,15 +586,23 @@ class Executor:
         n_scatters = sum(2 if a.func is E.AggFunc.AVG else 1 for a in aggs)
         seg_dims = seg_dims_for(groups, n_aggs=n_scatters,
                                 input_capacity=batch.capacity)
+        # packed-key single-sort path for everything the scatter path rejects:
+        # a host decision on bounds/dictionary sizes, so it keys the cache too
+        # (the spec's radices are static; its offsets ride the const pool)
+        pack_spec = None
+        if seg_dims is None and groups:
+            pack_spec = K.plan_group_packing(groups, comp.pool)
+            if pack_spec is not None:
+                tracing.counter("pack.agg")
         fp = ("agg", expr_fingerprint(gres + ares),
               tuple((a.func, a.dtype) for a in aggs),
               batch_proto_key(batch), out_schema,
-              comp.pool.signature(), tuple(comp.marks), seg_dims)
+              comp.pool.signature(), tuple(comp.marks), seg_dims, pack_spec)
 
         def build():
             def fn(b: DeviceBatch, consts) -> DeviceBatch:
                 return aggregate_batch(b, groups, specs, out_schema, consts,
-                                       seg_dims=seg_dims)
+                                       seg_dims=seg_dims, pack_spec=pack_spec)
             return fn
         out = self._jitted("agg", fp, build)(strip_dicts(batch),
                                              comp.pool.device_args())
@@ -737,7 +760,10 @@ class Executor:
         from igloo_tpu.exec.host import HostExecutor
         fp = HostExecutor._plan_fp(plan_node)
         if fp is None:
-            return self._maybe_shrink(batch)
+            # no stable hint key for this subtree (subqueries/window/union...):
+            # carry the padded lanes rather than pay a num_live() device->host
+            # sync (~0.1s on a tunneled TPU) on EVERY staged execution
+            return batch
         # capacity IS part of this key: an input subtree's capacity comes
         # from its scans (stable run-to-run for the same data), so including
         # it cannot cascade — and it keeps sf1/sf10 executions of the same
@@ -891,11 +917,18 @@ class Executor:
             # path: correct, possibly slow — the flag is data-dependent and
             # rare by construction)
             win = 2 if residual is None else 12
+            # pack the exact-verify lanes (union key ranges across both
+            # sides) so each window slot compares one lane, not one per key
+            pack_eq = K.plan_pair_packing(use_lk, use_rk, pool)
+            if pack_eq is not None:
+                tracing.counter("pack.semi")
+                consts = pool.device_args()  # re-snapshot with the offsets
             fn = self._jitted(
-                "join_semi", fpbase + (win,),
+                "join_semi", fpbase + (win, pack_eq, pool.signature()),
                 lambda: (lambda l, r, consts: semi_anti_phase(
                     l, r, use_lk, use_rk, lhx, rhx,
-                    jt is JoinType.ANTI, residual, win, consts)))
+                    jt is JoinType.ANTI, residual, win, consts,
+                    pack_eq=pack_eq)))
             tracing.counter("join.semi_sorted")
             out, truncated = fn(ls, rs, consts)
             if residual is not None:
@@ -966,14 +999,19 @@ class Executor:
         res, keys, comp = self._compile_exprs(plan.keys, batch)
         # ORDER BY over unsorted (high-cardinality) dictionaries sorts ranks
         keys = [rank_lane(k, comp) if k.dtype.is_string else k for k in keys]
+        # pack the longest integer-family key prefix into one sort lane
+        pack = K.plan_prefix_packing(keys, plan.ascending, plan.nulls_first,
+                                     comp.pool)
+        if pack is not None:
+            tracing.counter("pack.sort")
         fp = ("sort", expr_fingerprint(res), tuple(plan.ascending),
               tuple(plan.nulls_first), batch_proto_key(batch),
-              comp.pool.signature(), tuple(comp.marks))
+              comp.pool.signature(), tuple(comp.marks), pack)
 
         def build():
             def fn(b, consts):
                 return sort_batch(b, keys, plan.ascending, plan.nulls_first,
-                                  consts)
+                                  consts, pack=pack)
             return fn
         out = self._jitted("sort", fp, build)(strip_dicts(batch),
                                               comp.pool.device_args())

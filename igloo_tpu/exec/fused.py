@@ -56,6 +56,7 @@ from igloo_tpu.exec.join import (
 from igloo_tpu.exec.sort_limit import limit_batch, sort_batch
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
+from igloo_tpu.utils import tracing
 
 
 class FusionUnsupported(Exception):
@@ -475,15 +476,22 @@ class FusedCompiler:
             2 if a.func is _AF.AVG else 1 for a in plan.aggs)
         seg_dims = seg_dims_for(groups, n_aggs=n_scatters,
                                 input_capacity=meta.capacity)
+        # packed-key single-sort path when the scatter path doesn't apply;
+        # a host decision (bounds / dictionary sizes) -> part of the fused key
+        pack_spec = None
+        if seg_dims is None and groups:
+            pack_spec = K.plan_group_packing(groups, self.pool)
+            if pack_spec is not None:
+                tracing.counter("pack.agg")
         self._push(("agg", tuple(repr(e) for e in gres + ares),
                     tuple((a.func, a.dtype) for a in plan.aggs),
-                    plan.schema, seg_dims))
+                    plan.schema, seg_dims, pack_spec))
         out_schema = plan.schema
 
         def fn(leaves, consts, ctx):
             b = cfn(leaves, consts, ctx)
             return aggregate_batch(b, groups, specs, out_schema, consts,
-                                   seg_dims=seg_dims)
+                                   seg_dims=seg_dims, pack_spec=pack_spec)
         if not groups:
             cap = MIN_CAPACITY
         elif seg_dims is not None:
@@ -533,12 +541,18 @@ class FusedCompiler:
         res, keys = self._compile_exprs(plan.keys, comp)
         keys = [rank_lane(k, comp) if k.dtype.is_string else k for k in keys]
         self.marks.extend(comp.marks)
+        # pack the longest integer-family key prefix into one sort lane
+        pack = K.plan_prefix_packing(keys, plan.ascending, plan.nulls_first,
+                                     self.pool)
+        if pack is not None:
+            tracing.counter("pack.sort")
         self._push(("sort", tuple(repr(e) for e in res),
-                    tuple(plan.ascending), tuple(plan.nulls_first)))
+                    tuple(plan.ascending), tuple(plan.nulls_first), pack))
         asc, nf = list(plan.ascending), list(plan.nulls_first)
 
         def fn(leaves, consts, ctx):
-            return sort_batch(cfn(leaves, consts, ctx), keys, asc, nf, consts)
+            return sort_batch(cfn(leaves, consts, ctx), keys, asc, nf, consts,
+                              pack=pack)
         return fn, meta
 
     def _c_limit(self, plan: L.Limit):

@@ -118,6 +118,8 @@ class GraceJoinExecutor:
 
         lparts = self._partition_side(join.left, join.left_keys, n_parts)
         rparts = self._partition_side(join.right, join.right_keys, n_parts)
+        lbounds = self._union_bounds(join.left.schema, lparts)
+        rbounds = self._union_bounds(join.right.schema, rparts)
 
         # per-partition plan: the join with its sides replaced by scans of
         # the partition tables, plus the path segment BELOW the aggregate
@@ -133,7 +135,7 @@ class GraceJoinExecutor:
             lt, rt = lparts[p], rparts[p]
             if lt.num_rows == 0 or rt.num_rows == 0:
                 continue  # inner join: an empty side contributes nothing
-            sub = self._rebuild_join(join, lt, rt)
+            sub = self._rebuild_join(join, lt, rt, lbounds, rbounds)
             for node in reversed(below):
                 sub = _rewire(node, sub)
             if agg is not None:
@@ -215,14 +217,58 @@ class GraceJoinExecutor:
         # find_grace_join admits only bare bound columns
         return side.schema.fields[key.index].name
 
+    @staticmethod
+    def _union_bounds(schema: T.Schema, tables: list) -> dict:
+        """Per-column (lo, hi) over ALL partitions of one side, for integer-
+        family columns. Attached to every partition MemTable (fixed_bounds,
+        applied by Executor._exec_scan) so each partition presents IDENTICAL
+        bounds to the executor: per-partition exact bounds would fork the
+        jit/fused program caches P ways (bounds feed join-strategy constants
+        and packed-key radices), while union bounds keep ONE compiled program
+        per stage — and keep the packed-key single-sort path applicable inside
+        every partition join/aggregate (hash partitioning spreads each key
+        over its full global range anyway)."""
+        import pyarrow.compute as pc
+        out: dict = {}
+        for f in schema:
+            if not (f.dtype.is_integer or f.dtype.is_temporal):
+                continue
+            lo = hi = None
+            for t in tables:
+                if t.num_rows == 0:
+                    continue
+                # min_max consumes the ChunkedArray directly — no
+                # combine_chunks/cast copies in the path that exists because
+                # host memory is already tight; temporal scalars yield their
+                # lane integers (days / microseconds) via .value
+                mm = pc.min_max(t.column(f.name))
+                if not mm["min"].is_valid:
+                    continue
+                if f.dtype.is_temporal:
+                    mn, mx = mm["min"].value, mm["max"].value
+                else:
+                    mn, mx = mm["min"].as_py(), mm["max"].as_py()
+                lo = mn if lo is None else min(lo, mn)
+                hi = mx if hi is None else max(hi, mx)
+            if lo is not None:
+                out[f.name] = (int(lo), int(hi))
+        return out
+
     # --- plan surgery ---
 
     @staticmethod
-    def _rebuild_join(join: L.Join, lt: pa.Table, rt: pa.Table) -> L.Join:
+    def _rebuild_join(join: L.Join, lt: pa.Table, rt: pa.Table,
+                      lbounds: Optional[dict] = None,
+                      rbounds: Optional[dict] = None) -> L.Join:
         from igloo_tpu.catalog import MemTable
         j = L.copy_plan(join)
-        j.left = _mem_scan("__grace_l", MemTable(lt), join.left.schema)
-        j.right = _mem_scan("__grace_r", MemTable(rt), join.right.schema)
+        lm, rm = MemTable(lt), MemTable(rt)
+        if lbounds:
+            lm.fixed_bounds = lbounds
+        if rbounds:
+            rm.fixed_bounds = rbounds
+        j.left = _mem_scan("__grace_l", lm, join.left.schema)
+        j.right = _mem_scan("__grace_r", rm, join.right.schema)
         return j
 
 

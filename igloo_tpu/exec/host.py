@@ -413,6 +413,10 @@ class HostExecutor:
         gather. Returns (inv, card, decode) or None for the generic path."""
         parts = []  # (card, decoder(slots)->HCol)
         inv = None
+        prod = 1  # running COMBINED cardinality: the direct arrays (bincount
+        # targets, per-aggregate outputs) are prod-sized, so the same dense
+        # bound that limits each key's span must limit their product — two
+        # ~4n-span keys would otherwise attempt ~16n^2-slot allocations
         for c in gcols:
             nulls = c.nulls if c.nulls is not None and c.nulls.any() else None
             if c.dtype.is_string and c.dict is not None:
@@ -453,15 +457,12 @@ class HostExecutor:
                     isn = slots == card - 1
                     col = base_dec(np.where(isn, 0, slots), None)
                     return replace(col, nulls=isn if isn.any() else None)
+            prod *= card
+            if prod > 4 * n + 1024:
+                return None  # combined slot space would dwarf the input
             parts.append((card, dec))
             inv = codes if inv is None else inv * card + codes
-        total_bits = sum(int(np.ceil(np.log2(max(cd, 2))))
-                         for cd, _ in parts)
-        if total_bits >= 62:
-            return None
-        card = 1
-        for cd, _ in parts:
-            card *= cd
+        card = prod
 
         def decode(slots):
             cols, rest = [], slots

@@ -155,44 +155,53 @@ def probe_phase(left: DeviceBatch, right: DeviceBatch,
 
 def _probe_bounds(build_key: jax.Array, probe_key: jax.Array):
     """Per-probe-element lower/upper insertion positions in the sorted build
-    multiset, WITHOUT searchsorted: on TPU a searchsorted over an 8M-query
-    lane lowers to a ~23-pass gather loop (~1.5s), while a rank sort of the
-    concatenated keys is two stable sorts + a cumsum + a scatter (~0.3s per
-    bound). For a probe element at combined-sorted position p with
-    `probe_before` probe elements ahead of it, the number of build elements
-    ahead is p - probe_before — which IS the insertion bound; the tie-break
-    flag decides whether equal build keys count (upper) or not (lower)."""
+    multiset, with ONE combined sort, no searchsorted: on TPU a searchsorted
+    over an 8M-query lane lowers to a ~23-pass gather loop (~1.5s), and the
+    previous design paid one full (m+n)-lane stable sort PER bound (probe-first
+    tie-break for lower, build-first for upper). This version packs the side
+    tag into the key's low bit — hash bit 0 is dropped to make room (a 63-bit
+    hash; collisions only add verify-rejected candidates, never wrong rows) —
+    so a single stable sort orders every equal-key run probes-first:
+
+      lower(probe at sorted pos i) = builds strictly before i
+                                   = builds before the run (they all follow
+                                     the run's probes)
+      upper(probe at sorted pos i) = builds up to the END of its equal-key run
+                                     (run end via one reverse min-scan)
+
+    Both bounds then scatter back to the probe's original index. Net: one
+    argsort + one cumsum + one scan instead of two argsorts + two cumsums."""
     m = build_key.shape[0]
     n = probe_key.shape[0]
-    pos = jnp.arange(m + n, dtype=jnp.int64)
-    out = []
-    for probe_first in (True, False):  # True -> lower bound, False -> upper
-        # the tie-break IS the concatenation order under a stable sort:
-        # probes-first makes equal build keys sort after (lower bound),
-        # build-first makes them sort before (upper) — one stable argsort
-        # per bound, no extra tie lane
-        if probe_first:
-            keys = jnp.concatenate([probe_key, build_key])
-            probe_mask = pos < n     # original index < n is a probe element
-            probe_off = 0
-        else:
-            keys = jnp.concatenate([build_key, probe_key])
-            probe_mask = pos >= m
-            probe_off = m
-        perm = jnp.argsort(keys, stable=True)
-        is_probe = jnp.take(probe_mask, perm)
-        probe_before = jnp.cumsum(is_probe.astype(jnp.int64)) - is_probe
-        build_before = (pos - probe_before).astype(jnp.int32)
-        # scatter each probe element's bound back to its original index.
-        # Build elements route to the POSITIVE out-of-bounds sentinel `m + n`:
-        # negative indices would WRAP (jnp normalizes them before mode="drop"
-        # applies) and clobber probe slots
-        target = jnp.where(is_probe, jnp.take(pos, perm) - probe_off,
-                           jnp.int64(m + n))
-        bound = jnp.zeros((n,), dtype=jnp.int32).at[target].set(
-            build_before, mode="drop")
-        out.append(bound)
-    return out[0], out[1]
+    total = m + n
+    pos = jnp.arange(total, dtype=jnp.int32)
+    mask = np.int64(-2)  # ~1: drop the hash's low bit for the side tag
+    keys = jnp.concatenate([probe_key & mask, (build_key & mask) | np.int64(1)])
+    perm = jnp.argsort(keys, stable=True)
+    sk = jnp.take(keys, perm)
+    is_build = jnp.take(pos >= n, perm)
+    # builds at-or-before each sorted position; probes carry "builds before"
+    cb = jnp.cumsum(is_build.astype(jnp.int32))
+    lower = cb - is_build.astype(jnp.int32)
+    # end of each equal-key run (tag bit ignored): reverse running min over
+    # run-final positions
+    krun = sk | np.int64(1)
+    last = jnp.concatenate([krun[1:] != krun[:-1],
+                            jnp.ones((1,), dtype=bool)])
+    end_idx = jax.lax.associative_scan(
+        jnp.minimum, jnp.where(last, pos, jnp.int32(total)), reverse=True)
+    upper = jnp.take(cb, end_idx)
+    # scatter both bounds back to each probe element's original index. Build
+    # elements route to the POSITIVE out-of-bounds sentinel `m + n`: negative
+    # indices would WRAP (jnp normalizes them before mode="drop" applies) and
+    # clobber probe slots
+    orig = jnp.take(pos, perm)
+    target = jnp.where(is_build, jnp.int32(total), orig)
+    lo_out = jnp.zeros((n,), dtype=jnp.int32).at[target].set(
+        lower, mode="drop")
+    up_out = jnp.zeros((n,), dtype=jnp.int32).at[target].set(
+        upper, mode="drop")
+    return lo_out, up_out
 
 
 def _any_null(lanes: list[_KeyLanes], cap) -> jax.Array:
@@ -207,7 +216,8 @@ def semi_anti_phase(left: DeviceBatch, right: DeviceBatch,
                     left_keys: list, right_keys: list,
                     lhx: list, rhx: list, anti: bool,
                     residual: Optional[Compiled] = None,
-                    window: int = 2, consts: tuple = ()):
+                    window: int = 2, consts: tuple = (),
+                    pack_eq: Optional[tuple] = None):
     """SEMI/ANTI without candidate expansion: membership is a sorted search
     over the build side's combined key hash with EXACT verify-lane equality
     at a `window`-slot run. The expand program (scatter-max ownership +
@@ -221,6 +231,10 @@ def semi_anti_phase(left: DeviceBatch, right: DeviceBatch,
     the key's duplicate run must be tested: the window widens and a
     `truncated` flag reports any left row whose run may extend past it —
     the caller re-runs exactly (deferred overflow protocol).
+
+    `pack_eq` (kernels.plan_pair_packing, part of the caller's cache key)
+    fuses the per-key exact-verify lanes into ONE packed lane per side, so
+    each window slot pays one gather+compare instead of one per key.
 
     Returns (DeviceBatch, truncated flag)."""
     l_lanes = _key_lanes(left, left_keys, lhx, consts)
@@ -244,9 +258,23 @@ def semi_anti_phase(left: DeviceBatch, right: DeviceBatch,
     order = jnp.argsort(rmasked)
     rsorted = jnp.take(rmasked, order)
     rv_sorted = jnp.take(rvalid, order)
-    r_eq = [jnp.take(ln.astype(jnp.int64), order)
-            for kl in r_lanes for ln in kl.eq_lanes]
-    l_eq = [ln.astype(jnp.int64) for kl in l_lanes for ln in kl.eq_lanes]
+    if pack_eq is not None:
+        # integer-family keys only (planner-guaranteed): each key's eq_lanes
+        # is its single value lane, and the union-range digits make equal
+        # values share a digit across the two tables — the window loop below
+        # then pays ONE gather+compare per slot instead of one per key. NULL
+        # digits collide at 0, but null keys are already excluded from
+        # lvalid/rvalid.
+        l_eq = [K.pack_key_lane(pack_eq, [kl.eq_lanes[0] for kl in l_lanes],
+                                [kl.null for kl in l_lanes], consts)]
+        r_packed = K.pack_key_lane(pack_eq,
+                                   [kl.eq_lanes[0] for kl in r_lanes],
+                                   [kl.null for kl in r_lanes], consts)
+        r_eq = [jnp.take(r_packed, order)]
+    else:
+        r_eq = [jnp.take(ln.astype(jnp.int64), order)
+                for kl in r_lanes for ln in kl.eq_lanes]
+        l_eq = [ln.astype(jnp.int64) for kl in l_lanes for ln in kl.eq_lanes]
     lo = jnp.searchsorted(rsorted, lh)
     cap_r = right.capacity
     member = jnp.zeros(left.capacity, dtype=bool)

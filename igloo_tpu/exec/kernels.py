@@ -133,6 +133,196 @@ def lex_argsort(lanes: list, live: jax.Array) -> jax.Array:
     return perm
 
 
+# ---------------------------------------------------------------------------
+# Packed sort keys: fuse a multi-lane lexicographic key into ONE integer lane.
+#
+# The multi-lane chain (lex_argsort) pays one full stable sort per lane; a
+# TPC-H q18-shaped group-by carries 5 keys = 10+ lanes = 10+ 8M-lane sorts.
+# When every key is integer-family (ints, dates, timestamps, bools, dictionary
+# ids) with host-known value bounds (scan stats, DeviceColumn.bounds), the keys
+# bit-pack into one minimal-width integer digit string whose ordering equals
+# the lexicographic ordering — ONE argsort replaces the whole chain, and when
+# the digits fit 30 bits the lane is int32, halving sort bytes.
+#
+# Encoding per key (radix `card`, runtime offset `lo`):
+#   value digit vd = v - lo            (descending keys: vd = card-2 - vd)
+#   nulls-first:    digit = 0 for NULL else vd + 1
+#   nulls-last:     digit = card-1 for NULL else vd
+# Digits combine most-significant-first: acc = acc * card + digit. Radices are
+# rounded to powers of two and offsets ride the ConstPool as RUNTIME data, so
+# two executions whose bounds differ only in position (data refreshes, GRACE
+# partitions) share one compiled program — only the radix bucket is static.
+#
+# Fallback ladder: packed int32 (<= 30 digit bits) -> packed int64 (<= 62) ->
+# the multi-lane lex_argsort chain. One bit is always reserved for the
+# dead-row sentinel (packed_sort_key), hence 62/30, not 63/31.
+# ---------------------------------------------------------------------------
+
+PACK_BITS_I64 = 62
+PACK_BITS_I32 = 30
+
+
+def _pack_card(lo: int, hi: int) -> int:
+    """Per-key digit radix: power-of-two bucket of (span + NULL digit + 1
+    headroom slot, so nulls-first and nulls-last encodings share one radix)."""
+    span = int(hi) - int(lo) + 1
+    card = 2
+    while card < span + 2:
+        card <<= 1
+    return card
+
+
+def _key_pack_range(k):
+    """Host-known (lo, hi) value range of one key (a Compiled-shaped object:
+    .dtype / .out_dict / .out_bounds), or None when the key cannot pack.
+    Strings pack by dictionary id — callers that need ORDER semantics must
+    ensure ids are ranks (sorted dictionary) before planning."""
+    dt = k.dtype
+    if dt.id == T.TypeId.BOOL:
+        return (0, 1)
+    if dt.is_string:
+        d = k.out_dict
+        if d is None:
+            return None
+        return (0, max(len(d) - 1, 0))
+    if (dt.is_integer or dt.is_temporal) and k.out_bounds is not None:
+        return (int(k.out_bounds[0]), int(k.out_bounds[1]))
+    return None
+
+
+def _build_pack_spec(ranges: list, ascending: list, nulls_first: list, pool):
+    """(lane_tag, offsets_pool_idx, ((card, asc, nulls_first), ...)) or None
+    when the digits exceed the int64 budget. Hashable: safe in jit cache keys."""
+    digits = []
+    offsets = []
+    total = 1
+    for (lo, hi), asc, nf in zip(ranges, ascending, nulls_first):
+        card = _pack_card(lo, hi)
+        total *= card
+        if total > (1 << PACK_BITS_I64):
+            return None
+        offsets.append(int(lo))
+        digits.append((card, bool(asc), bool(nf)))
+    lane = "i32" if total <= (1 << PACK_BITS_I32) else "i64"
+    oidx = pool.add(np.asarray(offsets, dtype=np.int64))
+    return (lane, oidx, tuple(digits))
+
+
+def plan_group_packing(keys: list, pool):
+    """Pack plan for GROUP BY keys: grouping equality is symmetric, so ANY
+    subset of the keys may fuse into the packed lane (unlike ORDER BY, which
+    is limited to a prefix) — a q18-shaped 5-key group-by with one float key
+    packs the other four; the aggregate kernel then folds the float's
+    null/NaN flags into the packed lane's spare bits and sorts TWO lanes
+    instead of 10+. Returns (spec, packed_key_indices) or None when packing
+    would not drop at least one sort pass (fewer than 2 packable keys, unless
+    that single packable key is the whole key set)."""
+    if not keys:
+        return None
+    ranges = []
+    idxs = []
+    total = 1
+    for i, k in enumerate(keys):
+        r = _key_pack_range(k)
+        if r is None:
+            continue
+        card = _pack_card(*r)
+        if total * card > (1 << PACK_BITS_I64):
+            continue
+        total *= card
+        ranges.append(r)
+        idxs.append(i)
+    if not idxs or (len(idxs) < 2 and len(idxs) != len(keys)):
+        return None
+    n = len(idxs)
+    spec = _build_pack_spec(ranges, [True] * n, [True] * n, pool)
+    if spec is None:
+        return None
+    return spec, tuple(idxs)
+
+
+def plan_prefix_packing(keys: list, ascending, nulls_first, pool):
+    """Longest packable key PREFIX (most-significant keys first) for ORDER BY:
+    returns (spec, n_keys_packed) or None. A partial pack still pays: the
+    prefix collapses to one lex_argsort lane ahead of the unpackable tail."""
+    ranges = []
+    total = 1
+    for k in keys:
+        if k.dtype.is_string and \
+                (k.out_dict is None or not k.out_dict.is_sorted):
+            break
+        r = _key_pack_range(k)
+        if r is None:
+            break
+        if total * _pack_card(*r) > (1 << PACK_BITS_I64):
+            break
+        total *= _pack_card(*r)
+        ranges.append(r)
+    npk = len(ranges)
+    if npk == 0:
+        return None
+    spec = _build_pack_spec(ranges, list(ascending)[:npk],
+                            list(nulls_first)[:npk], pool)
+    if spec is None:
+        return None
+    return spec, npk
+
+
+def plan_pair_packing(left_keys: list, right_keys: list, pool):
+    """Shared pack spec for a join's residual-equality lanes: every key pair
+    must be integer-family on BOTH sides with host-known bounds; the digit
+    range is the union of the two sides' ranges (so equal values share a digit
+    across tables). Strings never qualify — their ids are per-dictionary."""
+    if not left_keys or len(left_keys) != len(right_keys):
+        return None
+    ranges = []
+    for lk, rk in zip(left_keys, right_keys):
+        if lk.dtype.is_string or rk.dtype.is_string:
+            return None
+        rl, rr = _key_pack_range(lk), _key_pack_range(rk)
+        if rl is None or rr is None:
+            return None
+        ranges.append((min(rl[0], rr[0]), max(rl[1], rr[1])))
+    n = len(ranges)
+    return _build_pack_spec(ranges, [True] * n, [True] * n, pool)
+
+
+def pack_key_lane(spec: tuple, vals: list, nulls: list,
+                  consts: tuple) -> jax.Array:
+    """Jit-traceable: normalized mixed-radix key digits -> one int lane whose
+    ascending order IS the keys' lexicographic order (per-key direction and
+    null placement baked into the digits). NULL lanes are replaced BEFORE the
+    radix combine, so garbage values under a null mask cannot poison other
+    keys' digits; dead-lane garbage wraps harmlessly and is overwritten by the
+    packed_sort_key sentinel before any consumer reads it."""
+    lane_tag, oidx, digits = spec
+    offsets = consts[oidx]
+    acc = None
+    for i, ((card, asc, nf), v, nl) in enumerate(zip(digits, vals, nulls)):
+        vd = v.astype(jnp.int64) - offsets[i]
+        if not asc:
+            vd = np.int64(card - 2) - vd
+        if nf:
+            d = vd + np.int64(1)
+            if nl is not None:
+                d = jnp.where(nl, np.int64(0), d)
+        else:
+            d = vd
+            if nl is not None:
+                d = jnp.where(nl, np.int64(card - 1), d)
+        acc = d if acc is None else acc * np.int64(card) + d
+    if lane_tag == "i32":
+        return acc.astype(jnp.int32)
+    return acc
+
+
+def packed_sort_key(packed: jax.Array, live: jax.Array) -> jax.Array:
+    """Displace dead rows to the dtype max so one argsort orders live rows by
+    key AND sorts dead rows last. Digits use at most 62 (int64) / 30 (int32)
+    bits, so the sentinel never collides with a live key."""
+    return jnp.where(live, packed, jnp.iinfo(packed.dtype).max)
+
+
 def group_segments(sorted_lanes: list, sorted_nulls: list,
                    sorted_live: jax.Array):
     """Given key lanes already permuted into sorted order, return

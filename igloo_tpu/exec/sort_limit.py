@@ -2,10 +2,16 @@
 
 The reference delegates ORDER BY/LIMIT to DataFusion entirely (no custom operator).
 TPU design: multi-key sort = k iterated stable argsorts over order-normalized int64
-lanes (kernels.lex_argsort) — no comparators, fully static shapes. LIMIT is a mask
-over the running live-row count, not a truncation, so shapes stay put.
+lanes (kernels.lex_argsort) — no comparators, fully static shapes. When a prefix of
+the keys is integer-family with host-known bounds, it packs into ONE lane
+(kernels.plan_prefix_packing; see docs/sort_keys.md), collapsing the chain — a
+fully packed ORDER BY is a single argsort that also handles dead-row placement.
+LIMIT is a mask over the running live-row count, not a truncation, so shapes
+stay put.
 """
 from __future__ import annotations
+
+from typing import Optional
 
 import jax.numpy as jnp
 
@@ -15,12 +21,30 @@ from igloo_tpu.exec.expr_compile import Compiled, Env
 
 
 def sort_batch(batch: DeviceBatch, keys: list[Compiled], ascending: list[bool],
-               nulls_first: list[bool], consts: tuple = ()) -> DeviceBatch:
-    """Jit-traceable stable sort; dead rows end up last."""
+               nulls_first: list[bool], consts: tuple = (),
+               pack: Optional[tuple] = None) -> DeviceBatch:
+    """Jit-traceable stable sort; dead rows end up last. `pack` (a host
+    decision from kernels.plan_prefix_packing, part of the caller's cache key)
+    is (spec, n) with the first n keys fused into one packed lane."""
     env = Env.from_batch(batch, consts)
-    lanes = []
-    for k, asc, nf in zip(keys, ascending, nulls_first):
+    vals, nls = [], []
+    for k in keys:
         v, nl = k.fn(env)
+        vals.append(v)
+        nls.append(nl)
+    lanes = []
+    start = 0
+    if pack is not None:
+        spec, start = pack
+        packed = K.pack_key_lane(spec, vals[:start], nls[:start], consts)
+        if start == len(keys):
+            # every key packed: one argsort orders rows AND sinks dead rows
+            perm = jnp.argsort(K.packed_sort_key(packed, batch.live),
+                               stable=True)
+            return K.apply_perm(batch, perm)
+        lanes.append((packed, True))
+    for k, v, nl, asc, nf in zip(keys[start:], vals[start:], nls[start:],
+                                 ascending[start:], nulls_first[start:]):
         lanes.extend(K.sort_lanes_for(v, nl, k.dtype.is_float, asc, nf))
     perm = K.lex_argsort(lanes, batch.live)
     return K.apply_perm(batch, perm)

@@ -231,6 +231,23 @@ def test_do_exchange_path_roundtrip(cluster):
     dc.close()
 
 
+def test_do_exchange_path_writeless_echo(cluster):
+    """A write-less path exchange (done_writing with no schema/batches) must
+    echo the stored table — the one failure mode the narrowed upload handler
+    is allowed to swallow (pyarrow's 'Client never sent a data message')."""
+    import pyarrow.flight as flight
+    client = flight.connect(f"grpc+tcp://{cluster['addr']}")
+    desc = flight.FlightDescriptor.for_path("orders")
+    writer, reader = client.do_exchange(desc)
+    writer.done_writing()
+    got = reader.read_all()
+    want = cluster["local"].execute("SELECT * FROM orders")
+    assert got.num_rows == want.num_rows
+    assert set(got.schema.names) == set(want.schema.names)
+    writer.close()
+    client.close()
+
+
 def test_poll_flight_info_action(cluster):
     import json as _json
 

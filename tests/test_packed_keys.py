@@ -1,0 +1,352 @@
+"""Packed-key single-sort fast path (exec/kernels.py plan_*_packing +
+pack_key_lane): property-style equivalence against the multi-lane lex_argsort
+path across dtypes, NULLs at digit boundaries, negative mins, descending /
+nulls-first variants, and the 62-bit overflow fallback. The packed path is a
+pure strength reduction — every test demands bit-identical results."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from igloo_tpu import types as T
+from igloo_tpu.exec import kernels as K
+from igloo_tpu.exec.aggregate import AggSpec, aggregate_batch
+from igloo_tpu.exec.batch import DeviceBatch, from_arrow, to_arrow
+from igloo_tpu.exec.expr_compile import Compiled, ConstPool
+from igloo_tpu.exec.sort_limit import sort_batch
+from igloo_tpu.plan.expr import AggFunc
+
+
+def col(batch: DeviceBatch, i: int, bounds="auto") -> Compiled:
+    f = batch.schema.fields[i]
+    b = batch.columns[i].bounds if bounds == "auto" else bounds
+    return Compiled(lambda env, _i=i: (env.values[_i], env.nulls[_i]),
+                    f.dtype, batch.columns[i].dictionary, out_bounds=b)
+
+
+def agg_schema(groups, aggs, names):
+    fields = [T.Field(n, g.dtype, True)
+              for g, n in zip(groups, names[: len(groups)])]
+    fields += [T.Field(n, a.out_dtype, True)
+               for a, n in zip(aggs, names[len(groups):])]
+    return T.Schema(fields)
+
+
+def rows_sorted(tbl: pa.Table):
+    def key(row):
+        return tuple((v is None, v) for v in row)
+    return sorted(zip(*tbl.to_pydict().values()), key=key)
+
+
+def mixed_batch(n=400, seed=0):
+    """Batch covering the packable dtype families + a float column: int64
+    with a NEGATIVE min and NULLs, int32, date32, bool, dictionary string
+    with NULLs, float values."""
+    rng = np.random.default_rng(seed)
+    k_int = rng.integers(-37, 12, n)
+    k_null = rng.random(n) < 0.25
+    return from_arrow(pa.table({
+        "ki": pa.array([None if nu else int(v)
+                        for v, nu in zip(k_int, k_null)], type=pa.int64()),
+        "k32": pa.array(rng.integers(100, 107, n), type=pa.int32()),
+        "kd": pa.array(rng.integers(9000, 9030, n),
+                       type=pa.int32()).cast(pa.date32()),
+        "kb": pa.array(rng.random(n) < 0.5),
+        "ks": pa.array(rng.choice(["apple", "pear", None, "fig"],
+                                  n).tolist()),
+        "v": rng.normal(size=n),
+    }))
+
+
+# --- planner -----------------------------------------------------------------
+
+
+class TestPlanners:
+    def test_int32_lane_when_digits_fit_30_bits(self):
+        b = mixed_batch()
+        plan = K.plan_group_packing([col(b, 1), col(b, 3)], ConstPool())
+        assert plan is not None and plan[0][0] == "i32"
+
+    def test_int64_lane_for_wide_digits(self):
+        wide = Compiled(lambda env: (env.values[0], None), T.INT64, None,
+                        out_bounds=(0, 1 << 40))
+        plan = K.plan_group_packing([wide], ConstPool())
+        assert plan is not None and plan[0][0] == "i64"
+
+    def test_overflow_falls_back_to_none(self):
+        # two 41-bit keys exceed the 62-bit digit budget (one bit is reserved
+        # for the dead-row sentinel, hence 62, not 63/64)
+        wide = Compiled(lambda env: (env.values[0], None), T.INT64, None,
+                        out_bounds=(0, 1 << 40))
+        assert K.plan_group_packing([wide, wide], ConstPool()) is None
+        # the ORDER BY prefix planner packs what fits and stops
+        prefix = K.plan_prefix_packing([wide, wide], [True] * 2, [True] * 2,
+                                       ConstPool())
+        assert prefix is not None and prefix[1] == 1
+
+    def test_unbounded_or_float_keys_unpackable(self):
+        b = mixed_batch()
+        no_bounds = col(b, 0, bounds=None)
+        assert K.plan_group_packing([no_bounds], ConstPool()) is None
+        fcol = col(b, 5)
+        assert K.plan_group_packing([fcol], ConstPool()) is None
+        assert K.plan_prefix_packing([fcol], [True], [True],
+                                     ConstPool()) is None
+
+    def test_group_packing_skips_unpackable_subset(self):
+        b = mixed_batch()
+        plan = K.plan_group_packing([col(b, 0), col(b, 5), col(b, 1)],
+                                    ConstPool())
+        assert plan is not None
+        _spec, idxs = plan
+        assert idxs == (0, 2)  # the float key stays on the lex path
+
+    def test_prefix_packing_stops_at_float(self):
+        b = mixed_batch()
+        keys = [col(b, 1), col(b, 5), col(b, 0)]
+        plan = K.plan_prefix_packing(keys, [True] * 3, [False] * 3,
+                                     ConstPool())
+        assert plan is not None and plan[1] == 1
+
+    def test_rank_order_requires_sorted_dictionary(self):
+        from igloo_tpu.exec.batch import DictInfo, hash64_bytes
+        vals = np.asarray(["b", "a", "c"], dtype=object)
+        unsorted = DictInfo(vals, hash64_bytes(vals, 0), hash64_bytes(vals, 1),
+                            is_sorted=False)
+        c = Compiled(lambda env: (env.values[0], None), T.STRING, unsorted)
+        # ORDER BY consumers need ids to be ranks: unsorted dicts don't pack
+        assert K.plan_prefix_packing([c], [True], [True], ConstPool()) is None
+        # grouping only needs a bijection: unsorted dictionaries still pack
+        assert K.plan_group_packing([c], ConstPool()) is not None
+
+
+# --- group-by equivalence ----------------------------------------------------
+
+
+class TestPackedAggregate:
+    def _compare(self, b, groups, aggs, names):
+        schema = agg_schema(groups, aggs, names)
+        pool = ConstPool()
+        plan = K.plan_group_packing(groups, pool)
+        assert plan is not None
+        consts = pool.device_args()
+        packed = to_arrow(aggregate_batch(b, groups, aggs, schema, consts,
+                                          pack_spec=plan))
+        lex = to_arrow(aggregate_batch(b, groups, aggs, schema, consts))
+        assert rows_sorted(packed) == rows_sorted(lex)
+
+    def test_all_dtypes_all_packed(self):
+        b = mixed_batch()
+        groups = [col(b, i) for i in (0, 1, 2, 3, 4)]
+        aggs = [AggSpec(AggFunc.SUM, col(b, 5), T.FLOAT64, None),
+                AggSpec(AggFunc.COUNT_STAR, None, T.INT64, None),
+                AggSpec(AggFunc.MIN, col(b, 5), T.FLOAT64, None)]
+        self._compare(b, groups, aggs,
+                      ["ki", "k32", "kd", "kb", "ks", "s", "c", "mn"])
+
+    def test_partial_pack_with_float_key(self):
+        # q18 shape: packable int keys + one float key -> packed lane + the
+        # float's nan/value lanes on the lex chain
+        b = mixed_batch(seed=3)
+        groups = [col(b, 0), col(b, 5), col(b, 1)]
+        aggs = [AggSpec(AggFunc.COUNT_STAR, None, T.INT64, None)]
+        self._compare(b, groups, aggs, ["ki", "v", "k32", "c"])
+
+    def test_folded_null_group_immune_to_nan_garbage(self):
+        # review-verified bug: the folded mixed path compares raw lanes with
+        # no null awareness, so a float key whose under-null storage is NaN on
+        # one row and finite on another must NOT split the NULL group — the
+        # null mask is applied before the NaN flag derives
+        import jax.numpy as jnp
+        t = pa.table({"a": pa.array([1, 1, 2], type=pa.int64()),
+                      "b": pa.array([5, 5, 5], type=pa.int64()),
+                      "v": pa.array([1.0, 2.0, 3.0])})
+        b = from_arrow(t)
+        garbage = np.zeros(b.capacity)
+        garbage[:3] = [np.nan, 1.0, 2.0]
+        nulls = np.zeros(b.capacity, dtype=bool)
+        nulls[:2] = True
+        fkey = Compiled(lambda env: (jnp.asarray(garbage),
+                                     jnp.asarray(nulls)), T.FLOAT64, None)
+        groups = [col(b, 0), col(b, 1), fkey]
+        aggs = [AggSpec(AggFunc.COUNT_STAR, None, T.INT64, None)]
+        schema = agg_schema(groups, aggs, ["a", "b", "f", "c"])
+        pool = ConstPool()
+        plan = K.plan_group_packing(groups, pool)
+        assert plan is not None and plan[1] == (0, 1)
+        packed = to_arrow(aggregate_batch(b, groups, aggs, schema,
+                                          pool.device_args(), pack_spec=plan))
+        lex = to_arrow(aggregate_batch(b, groups, aggs, schema,
+                                       pool.device_args()))
+        assert rows_sorted(packed) == rows_sorted(lex)
+        assert packed.num_rows == 2  # (1,5,NULL) is ONE group
+
+    def test_null_at_digit_boundaries(self):
+        # NULL takes digit 0; values at the EXACT min/max of the bounds must
+        # stay distinct from the NULL group and from each other
+        t = pa.table({
+            "k": pa.array([-5, -5, None, None, 7, 7, -5], type=pa.int64()),
+            "v": pa.array([1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0]),
+        })
+        b = from_arrow(t)
+        groups = [col(b, 0, bounds=(-5, 7))]
+        aggs = [AggSpec(AggFunc.SUM, col(b, 1), T.FLOAT64, None)]
+        schema = agg_schema(groups, aggs, ["k", "s"])
+        pool = ConstPool()
+        plan = K.plan_group_packing(groups, pool)
+        out = to_arrow(aggregate_batch(b, groups, aggs, schema,
+                                       pool.device_args(),
+                                       pack_spec=plan)).to_pydict()
+        got = dict(zip(out["k"], out["s"]))
+        assert got == {-5: 67.0, None: 12.0, 7: 48.0}
+
+
+# --- ORDER BY equivalence ----------------------------------------------------
+
+
+class TestPackedSort:
+    @pytest.mark.parametrize("asc", [True, False])
+    @pytest.mark.parametrize("nf", [True, False])
+    def test_two_key_full_pack(self, asc, nf):
+        b = mixed_batch(seed=4)
+        keys = [col(b, 0), col(b, 2)]
+        ascending, nulls_first = [asc, True], [nf, False]
+        pool = ConstPool()
+        pack = K.plan_prefix_packing(keys, ascending, nulls_first, pool)
+        assert pack is not None and pack[1] == 2
+        consts = pool.device_args()
+        packed = to_arrow(sort_batch(b, keys, ascending, nulls_first, consts,
+                                     pack=pack))
+        lex = to_arrow(sort_batch(b, keys, ascending, nulls_first, consts))
+        # full row-order equality, including the stability tie-break
+        assert packed.to_pydict() == lex.to_pydict()
+
+    def test_prefix_pack_with_float_tail(self):
+        b = mixed_batch(seed=5)
+        keys = [col(b, 1), col(b, 5)]
+        ascending, nulls_first = [False, True], [False, True]
+        pool = ConstPool()
+        pack = K.plan_prefix_packing(keys, ascending, nulls_first, pool)
+        assert pack is not None and pack[1] == 1
+        consts = pool.device_args()
+        packed = to_arrow(sort_batch(b, keys, ascending, nulls_first, consts,
+                                     pack=pack))
+        lex = to_arrow(sort_batch(b, keys, ascending, nulls_first, consts))
+        assert packed.to_pydict() == lex.to_pydict()
+
+    def test_sorted_dictionary_string_key_packs(self):
+        b = mixed_batch(seed=6)
+        keys = [col(b, 4), col(b, 1)]
+        pool = ConstPool()
+        pack = K.plan_prefix_packing(keys, [True, True], [False, False], pool)
+        assert pack is not None and pack[1] == 2
+        consts = pool.device_args()
+        packed = to_arrow(sort_batch(b, keys, [True, True], [False, False],
+                                     consts, pack=pack))
+        lex = to_arrow(sort_batch(b, keys, [True, True], [False, False],
+                                  consts))
+        assert packed.to_pydict() == lex.to_pydict()
+
+
+# --- join probe bounds + packed semi verify ----------------------------------
+
+
+class TestJoinPacking:
+    def test_probe_bounds_matches_searchsorted_oracle(self):
+        import jax.numpy as jnp
+
+        from igloo_tpu.exec.join import _probe_bounds
+        rng = np.random.default_rng(7)
+        # EVEN keys: the tag bit borrowed from the hash's LSB is free, so the
+        # single-sort bounds must equal exact searchsorted bounds
+        build = np.sort(rng.integers(-1000, 1000, 128)) * 2
+        probe = rng.integers(-1200, 1200, 256) * 2
+        lo, up = _probe_bounds(jnp.asarray(build, jnp.int64),
+                               jnp.asarray(probe, jnp.int64))
+        assert (np.asarray(lo) == np.searchsorted(build, probe, "left")).all()
+        assert (np.asarray(up) == np.searchsorted(build, probe, "right")).all()
+
+    def test_probe_bounds_superset_on_arbitrary_keys(self):
+        import jax.numpy as jnp
+
+        from igloo_tpu.exec.join import _probe_bounds
+        rng = np.random.default_rng(8)
+        build = np.sort(rng.integers(-50, 50, 128))
+        probe = rng.integers(-60, 60, 256)
+        lo, up = _probe_bounds(jnp.asarray(build, jnp.int64),
+                               jnp.asarray(probe, jnp.int64))
+        lo, up = np.asarray(lo), np.asarray(up)
+        # dropping the hash LSB may only WIDEN the candidate range (extra
+        # candidates are rejected by exact verification downstream)
+        assert (lo <= np.searchsorted(build, probe, "left")).all()
+        assert (up >= np.searchsorted(build, probe, "right")).all()
+
+    @pytest.mark.parametrize("anti", [False, True])
+    def test_semi_anti_packed_verify_lanes(self, anti):
+        from igloo_tpu.exec.join import semi_anti_phase
+        rng = np.random.default_rng(9)
+        lt = pa.table({
+            "a": pa.array([None if x == 0 else int(x)
+                           for x in rng.integers(0, 25, 120)],
+                          type=pa.int64()),
+            "a2": pa.array(rng.integers(-6, 6, 120), type=pa.int32())})
+        rt = pa.table({
+            "b": pa.array(rng.integers(0, 25, 90), type=pa.int64()),
+            "b2": pa.array(rng.integers(-6, 6, 90), type=pa.int32())})
+        lb, rb = from_arrow(lt), from_arrow(rt)
+        lk, rk = [col(lb, 0), col(lb, 1)], [col(rb, 0), col(rb, 1)]
+        pool = ConstPool()
+        pack_eq = K.plan_pair_packing(lk, rk, pool)
+        assert pack_eq is not None
+        consts = pool.device_args()
+        plain, _ = semi_anti_phase(lb, rb, lk, rk, [None, None], [None, None],
+                                   anti, None, 2, consts)
+        packed, _ = semi_anti_phase(lb, rb, lk, rk, [None, None], [None, None],
+                                    anti, None, 2, consts, pack_eq=pack_eq)
+        assert to_arrow(packed).to_pydict() == to_arrow(plain).to_pydict()
+
+    def test_pair_packing_rejects_strings(self):
+        b = mixed_batch()
+        assert K.plan_pair_packing([col(b, 4)], [col(b, 4)],
+                                   ConstPool()) is None
+
+
+# --- engine-level adoption ---------------------------------------------------
+
+
+class TestEngineAdoption:
+    def test_packed_group_by_matches_pandas_and_counts(self):
+        from igloo_tpu.engine import QueryEngine
+        from igloo_tpu.utils import tracing
+        rng = np.random.default_rng(10)
+        n = 2000
+        t = pa.table({
+            "k1": pa.array(rng.integers(-3, 3, n), type=pa.int64()),
+            "k2": pa.array(rng.integers(500, 1500, n), type=pa.int64()),
+            "f": rng.normal(size=n),
+        })
+        eng = QueryEngine()
+        eng.register_table("pk", t)
+        before = tracing.counters().get("pack.agg", 0)
+        got = eng.execute("SELECT k1, k2, f, COUNT(*) AS c, SUM(f) AS s "
+                          "FROM pk GROUP BY k1, k2, f ORDER BY k1, k2, f")
+        assert tracing.counters().get("pack.agg", 0) > before
+        df = t.to_pandas()
+        want = df.groupby(["k1", "k2", "f"], as_index=False).agg(
+            c=("f", "size"), s=("f", "sum")).sort_values(["k1", "k2", "f"])
+        assert got.column("c").to_pylist() == want["c"].tolist()
+        np.testing.assert_allclose(got.column("s").to_pylist(),
+                                   want["s"].tolist(), atol=1e-9)
+
+    def test_overflow_query_still_correct(self):
+        # keys whose combined digits exceed the 62-bit budget: planner bails,
+        # the lex path answers, results stay right
+        from igloo_tpu.engine import QueryEngine
+        t = pa.table({
+            "w1": pa.array([0, 1 << 41, 0, 1 << 41], type=pa.int64()),
+            "w2": pa.array([5, 5, 1 << 41, 5], type=pa.int64()),
+        })
+        eng = QueryEngine()
+        eng.register_table("wide", t)
+        got = eng.execute("SELECT w1, w2, COUNT(*) AS c FROM wide "
+                          "GROUP BY w1, w2 ORDER BY w1, w2")
+        assert got.column("c").to_pylist() == [1, 1, 2]

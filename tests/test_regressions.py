@@ -190,6 +190,49 @@ def test_sort_path_aggregate_inf_isolated():
     assert got.column("c").to_pylist() == [2, 2, 1]
 
 
+def test_q18_shaped_multi_column_group_by_packed():
+    """ISSUE 1 tentpole regression: the q18-shaped multi-column group-by
+    (string + int + date + float keys above a join) must take the packed-key
+    single-sort path for its packable keys and match the pandas oracle."""
+    import numpy as np
+    import pandas as pd
+
+    from igloo_tpu.utils import tracing
+    rng = np.random.default_rng(18)
+    n_ord, n_li = 300, 1200
+    orders = pa.table({
+        "o_orderkey": pa.array(np.arange(n_ord), type=pa.int64()),
+        "o_custkey": pa.array(rng.integers(0, 40, n_ord), type=pa.int64()),
+        "o_orderdate": pa.array(rng.integers(9000, 9100, n_ord),
+                                type=pa.int32()).cast(pa.date32()),
+        "o_totalprice": rng.normal(1000.0, 200.0, n_ord),
+    })
+    lineitem = pa.table({
+        "l_orderkey": pa.array(rng.integers(0, n_ord, n_li), type=pa.int64()),
+        "l_quantity": rng.integers(1, 50, n_li).astype(np.float64),
+    })
+    eng = QueryEngine()
+    eng.register_table("orders", orders)
+    eng.register_table("lineitem", lineitem)
+    before = tracing.counters().get("pack.agg", 0)
+    got = eng.execute(
+        "SELECT o_custkey, o_orderkey, o_orderdate, o_totalprice, "
+        "SUM(l_quantity) AS sq "
+        "FROM orders JOIN lineitem ON o_orderkey = l_orderkey "
+        "GROUP BY o_custkey, o_orderkey, o_orderdate, o_totalprice "
+        "ORDER BY o_totalprice DESC, o_orderkey LIMIT 25").to_pandas()
+    assert tracing.counters().get("pack.agg", 0) > before
+    m = orders.to_pandas().merge(lineitem.to_pandas(),
+                                 left_on="o_orderkey", right_on="l_orderkey")
+    want = m.groupby(["o_custkey", "o_orderkey", "o_orderdate",
+                      "o_totalprice"], as_index=False)["l_quantity"].sum()
+    want = want.sort_values(["o_totalprice", "o_orderkey"],
+                            ascending=[False, True]).head(25)
+    want = want.rename(columns={"l_quantity": "sq"}).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), want,
+                                  check_dtype=False)
+
+
 def test_not_in_three_valued_null_semantics():
     """Uncorrelated NOT IN (round-4 keyed-anti + scalar-guard rewrite) must
     keep SQL's three-valued logic: NULL in the subquery empties the result,
