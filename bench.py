@@ -233,7 +233,11 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
             "cold_s": rec["cold_s"], "warm_med_s": med, "warm_min_s": lo,
             "warm_max_s": hi, "cached_s": rec["cached_s"],
             "packed": rec.get("packed", False),
+            "grace": rec.get("grace", False),
             "rows_per_s": round(rps)}
+        for k in ("grace_partitions", "grace_pipeline"):
+            if k in rec:
+                block["queries"][q][k] = rec[k]
         log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s "
             f"[{lo:.4f},{hi:.4f}] ({rps:,.0f} rows/s)")
 

@@ -46,11 +46,19 @@ _CONVERGENCE_COUNTERS = ("jit.miss", "fused.compact_repair",
 # rounds can attribute wins to that path
 _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 
+# out-of-core GRACE adoption (exec/grace.py): per-query route flag, partition
+# count and pipeline A/B flag ride the sweep JSON so BENCH rounds can
+# attribute SF10 numbers to the partitioned tier and compare
+# IGLOO_GRACE_PIPELINE=0/1 runs
+_GRACE_COUNTERS = ("engine.grace_route", "grace.join", "grace.partitions",
+                   "grace.pipeline")
+
 
 def run_query(engine, sql: str, trials: int) -> dict:
     """cold -> hint-adoption re-runs -> warm trials -> result-cached run."""
     from igloo_tpu.utils import tracing
     pack_before = {k: tracing.counters().get(k, 0) for k in _PACK_COUNTERS}
+    grace_before = {k: tracing.counters().get(k, 0) for k in _GRACE_COUNTERS}
     t0 = time.perf_counter()
     engine.execute(sql)
     cold = time.perf_counter() - t0
@@ -78,11 +86,25 @@ def run_query(engine, sql: str, trials: int) -> dict:
     engine.execute(sql)
     cached = time.perf_counter() - t0
     pack_after = tracing.counters()
-    return {"cold_s": round(cold, 4),
-            "warm_trials": [round(w, 4) for w in warm],
-            "cached_s": round(cached, 4),
-            "packed": any(pack_after.get(k, 0) > pack_before[k]
-                          for k in _PACK_COUNTERS)}
+    rec = {"cold_s": round(cold, 4),
+           "warm_trials": [round(w, 4) for w in warm],
+           "cached_s": round(cached, 4),
+           "packed": any(pack_after.get(k, 0) > pack_before[k]
+                         for k in _PACK_COUNTERS)}
+    joins = pack_after.get("grace.join", 0) - grace_before["grace.join"]
+    rec["grace"] = pack_after.get("engine.grace_route", 0) > \
+        grace_before["engine.grace_route"]
+    if rec["grace"]:
+        parts = pack_after.get("grace.partitions", 0) - \
+            grace_before["grace.partitions"]
+        # per-execution partition count (the query ran several times above)
+        rec["grace_partitions"] = parts // max(joins, 1)
+        # whether the double-buffered loop actually RAN (the counter), not
+        # just whether the env flag allowed it — recursive-mode and
+        # single-partition executions fall back to the serial loop
+        rec["grace_pipeline"] = pack_after.get("grace.pipeline", 0) > \
+            grace_before["grace.pipeline"]
+    return rec
 
 
 def main(argv=None) -> int:

@@ -167,10 +167,23 @@ class QueryEngine:
             plan = optimize(bound)
             text = L.plan_tree_str(plan)
             if stmt.analyze:
-                ex = self._executor()
+                # EXPLAIN ANALYZE executes through the SAME routing ladder as
+                # a real query (host / chunked / GRACE / normal) and surfaces
+                # the out-of-core phase breakdown when GRACE ran
+                c0 = tracing.counters()
                 t1 = time.perf_counter()
-                ex.execute_to_arrow(plan)
+                self._execute_plan(plan)
                 text += f"\n-- execution: {time.perf_counter() - t1:.4f}s"
+                c1 = tracing.counters()
+                nparts = c1.get("grace.partitions", 0) - \
+                    c0.get("grace.partitions", 0)
+                if nparts:
+                    text += f"\n-- grace.partitions: {nparts}"
+                for ph in ("partition", "join", "merge"):
+                    ms = c1.get(f"grace.{ph}_ms", 0) - \
+                        c0.get(f"grace.{ph}_ms", 0)
+                    if ms:
+                        text += f"\n-- grace.{ph}_s: {ms / 1000:.3f}"
             return QueryResult(pa.table({"plan": text.split("\n")}), plan=plan,
                                elapsed_s=time.perf_counter() - t0)
         if isinstance(stmt, A.CreateTableAsStmt):
@@ -223,21 +236,15 @@ class QueryEngine:
         total = _est_scan_bytes(plan, include_subqueries=True)
         return total is not None and total <= self.host_route_bytes
 
-    def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
+    def _execute_plan(self, plan: L.LogicalPlan) -> pa.Table:
+        """The full routing ladder shared by _run_select and EXPLAIN ANALYZE:
+        host tier (small sources on a tunneled accelerator) -> chunked tier
+        (decomposable aggregates over big scans) -> GRACE tier (over-budget
+        join trees, exec/grace.py) -> normal executor. A resolved multi-chip
+        mesh takes precedence over single-device chunking / out-of-core: the
+        sharded executor already bounds per-chip memory by row-sharding, and
+        silently chunking would discard the parallelism."""
         from igloo_tpu.exec.chunked import LocalChunkExecutor, chunk_count
-        from igloo_tpu.exec.result_cache import plan_cache_key
-        with span("bind+optimize"):
-            bound = Binder(self.catalog, udfs=self.udfs).bind(stmt)
-            plan = optimize(bound)
-        rkey = plan_cache_key(plan)
-        if rkey is not None:
-            hit = self.result_cache.get(rkey)
-            if hit is not None:
-                return (hit, plan) if want_plan else hit
-        # a resolved multi-chip mesh takes precedence over single-device
-        # chunking/out-of-core: the sharded executor already bounds per-chip
-        # memory by row-sharding, and silently chunking would discard the
-        # parallelism
         if self._host_route(plan):
             from igloo_tpu.exec.host import HostExecutor, HostUnsupported
             try:
@@ -246,9 +253,7 @@ class QueryEngine:
                         self.catalog,
                         scan_cache=self.host_cache).execute_to_arrow(plan)
                 tracing.counter("engine.host_route")
-                if rkey is not None:
-                    self.result_cache.put(rkey, table)
-                return (table, plan) if want_plan else table
+                return table
             except HostUnsupported as e:
                 tracing.counter("engine.host_route_unsupported")
                 tracing.counter(
@@ -268,19 +273,31 @@ class QueryEngine:
         with span("execute"):
             if chunks:
                 tracing.counter("engine.chunked_route")
-                table = LocalChunkExecutor(
+                return LocalChunkExecutor(
                     self.catalog, self._jit_cache, use_jit=self._use_jit,
                     batch_cache=self.batch_cache,
                     chunks=chunks).execute_to_arrow(plan)
-            elif grace_found:
+            if grace_found:
                 from igloo_tpu.exec.grace import GraceJoinExecutor
                 tracing.counter("engine.grace_route")
-                table = GraceJoinExecutor(
+                return GraceJoinExecutor(
                     self.catalog, self._jit_cache, use_jit=self._use_jit,
-                    batch_cache=self.batch_cache,
-                    hints=self.hint_store).execute_to_arrow(plan, grace_found)
-            else:
-                table = self._executor().execute_to_arrow(plan)
+                    batch_cache=self.batch_cache, hints=self.hint_store,
+                    budget_bytes=self.chunk_budget_bytes,
+                ).execute_to_arrow(plan, grace_found)
+            return self._executor().execute_to_arrow(plan)
+
+    def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
+        from igloo_tpu.exec.result_cache import plan_cache_key
+        with span("bind+optimize"):
+            bound = Binder(self.catalog, udfs=self.udfs).bind(stmt)
+            plan = optimize(bound)
+        rkey = plan_cache_key(plan)
+        if rkey is not None:
+            hit = self.result_cache.get(rkey)
+            if hit is not None:
+                return (hit, plan) if want_plan else hit
+        table = self._execute_plan(plan)
         if rkey is not None:
             self.result_cache.put(rkey, table)
         if want_plan:

@@ -462,9 +462,14 @@ def from_arrow(
     capacity: Optional[int] = None,
     dictionaries: Optional[dict[str, DictInfo]] = None,
     device=None,
+    null_fields: Optional[set] = None,
 ) -> DeviceBatch:
     """pyarrow Table -> DeviceBatch (host decode -> narrowed device_put into
-    HBM -> on-device widen, one dispatch for the whole batch)."""
+    HBM -> on-device widen, one dispatch for the whole batch). Columns named
+    in `null_fields` always get a null lane (all-False when the data has no
+    nulls): the GRACE partition pipeline forces one shape per leaf across all
+    partitions so null-free buckets key the same compiled programs as bucket
+    siblings that do carry nulls."""
     from igloo_tpu.exec.codec import live_lane
     if schema is None:
         schema = schema_from_arrow(table.schema)
@@ -472,6 +477,10 @@ def from_arrow(
     cap = capacity or round_capacity(n)
     decoded = [host_decode_column(table.column(f.name), f, dictionaries)
                for f in schema]
+    if null_fields:
+        decoded = [(v, np.zeros(n, dtype=bool)
+                    if nm is None and f.name in null_fields else nm, di, b)
+                   for f, (v, nm, di, b) in zip(schema, decoded)]
     cols = device_columns(decoded, list(schema), cap, device=device)
     return DeviceBatch(schema, cols, live_lane(cap, n, device=device))
 

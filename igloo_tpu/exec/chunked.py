@@ -13,9 +13,9 @@ Ceiling (documented per the build plan): only decomposable-aggregate-over-scan
 pipelines (Q1/Q6 shape) actually stream chunk-at-a-time — `chunk_count` routes
 ONLY those here. Plans whose over-budget scan feeds anything else (a bare
 sort/limit, a join side, a DISTINCT aggregate) would union all chunks back into
-one device batch, so they take the normal path unchanged; bounding join memory
-needs a partitioned (grace) hash join, which the sharded tier provides across
-chips but the single-device chunk path does not yet.
+one device batch, so they take the normal path unchanged; over-budget JOIN
+trees route through the partitioned GRACE tier instead (exec/grace.py — see
+docs/out_of_core.md for the full fallback ladder).
 
 Reference analog: the 1024-row streaming read batches of
 crates/engine/src/operators/parquet_scan.rs:54, which flow through operators
@@ -28,6 +28,7 @@ from typing import Optional
 import pyarrow as pa
 
 from igloo_tpu.plan import logical as L
+from igloo_tpu.utils import tracing
 
 
 def estimated_bytes(provider) -> Optional[int]:
@@ -75,8 +76,21 @@ def chunk_count(plan: L.LogicalPlan, budget_bytes: int) -> int:
                 except Exception:
                     parts = 1
                 if nbytes is not None and nbytes > budget_bytes and parts > 1:
-                    want = max(want,
-                               min(parts, -(-nbytes // budget_bytes), 64))
+                    # the chunk count is DERIVED from the budget (how many
+                    # budget-sized pieces the table decodes into); the only
+                    # clamp left is the provider's own partition granularity,
+                    # and hitting it means per-chunk memory exceeds the
+                    # budget — warn instead of silently un-bounding (the old
+                    # hard min(.., 64) did exactly that past 64x budgets)
+                    need = -(-nbytes // max(budget_bytes, 1))
+                    if need > parts:
+                        tracing.counter("chunked.chunks_clamped")
+                        tracing.log.warning(
+                            "chunked: %d chunks needed to bound memory but "
+                            "provider has only %d partitions; per-chunk "
+                            "working set will exceed the %d-byte budget",
+                            need, parts, budget_bytes)
+                    want = max(want, min(parts, need))
     return want
 
 

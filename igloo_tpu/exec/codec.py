@@ -103,6 +103,51 @@ def _shrink_int(v: np.ndarray, lane: np.dtype):
 
 _FLOAT_SCALES = (1.0, 100.0, 10000.0)
 
+# one-time on-device canary for the scaled-decimal path: None = not yet run.
+# The host verifies ``c / scale == v`` in IEEE f64, but the device replays the
+# divide under (possibly emulated) f64 — on a backend whose emulation is not
+# IEEE-correct the host check would promise an exactness the device cannot
+# deliver. The canary replays representative carriers for EVERY scale through
+# the same jitted divide (runtime scale argument, exactly like
+# WidenSpec.widen) at first upload; any mismatch disables scaled-decimal
+# shrinking process-wide and those columns fall back to wide lanes
+# (f32 round-trip or raw f64). Round-5 advisor item.
+_decimal_canary_ok: Optional[bool] = None
+
+
+def _scaled_decimal_ok() -> bool:
+    global _decimal_canary_ok
+    if _decimal_canary_ok is None:
+        import jax
+        import jax.numpy as jnp
+        ok = True
+        # carriers spanning the int32 range incl. values whose quotient is
+        # inexact in binary (odd cents / odd hundredths of cents)
+        c = np.concatenate([
+            np.arange(-999, 1000, 7, dtype=np.int64),
+            np.asarray([_I32[0], _I32[1], 1, -1, 3, 99, 12345679,
+                        987654321, -123456789], dtype=np.int64)])
+        try:
+            div = jax.jit(lambda a, s: a.astype(jnp.float64) / s)
+            for scale in _FLOAT_SCALES:
+                host = c.astype(np.float64) / np.float64(scale)
+                dev = np.asarray(div(jnp.asarray(c.astype(np.int32)),
+                                     jnp.asarray(np.float64(scale))))
+                if not np.array_equal(dev, host):
+                    ok = False
+                    break
+        except Exception:
+            ok = False
+        _decimal_canary_ok = ok
+        from igloo_tpu.utils import tracing
+        tracing.counter("codec.decimal_canary_ok" if ok
+                        else "codec.decimal_canary_fail")
+        if not ok:
+            tracing.log.warning(
+                "codec: on-device scaled-decimal canary FAILED; decimal "
+                "columns will ship as wide lanes (f32/f64) instead")
+    return _decimal_canary_ok
+
 
 def _shrink_float(v: np.ndarray, lane: np.dtype):
     """Scaled-decimal or f32 round-trip shrink for a float array."""
@@ -111,6 +156,11 @@ def _shrink_float(v: np.ndarray, lane: np.dtype):
     finite = np.isfinite(v)
     if finite.all():
         for scale in _FLOAT_SCALES:
+            # scale 1.0 widens by pure int->float CAST (no division), so it
+            # needs no canary; the divided scales are gated on the device
+            # replaying the host-verified divide bit-for-bit
+            if scale != 1.0 and not _scaled_decimal_ok():
+                continue
             c = np.rint(v * scale)
             if not ((c >= _I32[0]).all() and (c <= _I32[1]).all()):
                 continue

@@ -402,6 +402,15 @@ class Executor:
         return batch
 
     def _scan_batch(self, plan: L.Scan) -> DeviceBatch:
+        # GRACE partition pipeline: the prefetch thread already decoded,
+        # narrowed and device_put this bucket while the previous partition's
+        # program ran — hand its batch through without touching the caches
+        # (the provider lives exactly one partition, so caching it would only
+        # pin dead HBM)
+        pre = getattr(plan.provider, "prebuilt_batch", None) \
+            if plan.provider is not None else None
+        if pre is not None:
+            return pre
         stable = getattr(plan.provider, "stable_row_order", False)
         if self._batch_cache is None or not stable:
             # whole-batch path: providers without deterministic row order

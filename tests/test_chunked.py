@@ -85,6 +85,33 @@ def test_chunked_join_with_small_side(big):
     _same(chunked.execute(sql), plain.execute(sql))
 
 
+def test_chunk_cap_derived_from_budget(big):
+    """The chunk count is derived from the budget, not capped at 64; when the
+    provider cannot split finely enough to bound per-chunk memory, the clamp
+    is reported via the chunked.chunks_clamped counter instead of silently
+    un-bounding."""
+    from igloo_tpu.connectors.parquet import ParquetTable
+    from igloo_tpu.exec.chunked import chunk_count, estimated_lane_bytes
+    from igloo_tpu.utils import tracing
+    path, _ = big
+    eng = QueryEngine()
+    eng.register_table("t", ParquetTable(path))
+    plan = eng.plan("SELECT s, SUM(v) AS sv FROM t GROUP BY s")
+    prov = eng.catalog.get("t")
+    nbytes = estimated_lane_bytes(prov)
+    parts = prov.num_partitions()  # 14 row groups
+    # budget small enough that the NEED exceeds the provider's partitions:
+    # the count clamps to `parts` and the warning counter fires
+    tracing.reset_counters()
+    assert chunk_count(plan, nbytes // (parts * 4)) == parts
+    assert tracing.counters().get("chunked.chunks_clamped", 0) == 1
+    # a budget the provider CAN honor derives the exact need, un-clamped
+    tracing.reset_counters()
+    budget = -(-nbytes // (parts - 2))
+    assert chunk_count(plan, budget) == parts - 2
+    assert not tracing.counters().get("chunked.chunks_clamped")
+
+
 def test_memtable_chunking():
     rng = np.random.default_rng(9)
     n = 5000

@@ -97,3 +97,43 @@ def test_big_int64_keys_unshrunk_exact():
 def test_live_lane():
     live = np.asarray(codec.live_lane(16, 5))
     assert live.tolist() == [True] * 5 + [False] * 11
+
+
+def test_decimal_canary_passes_on_cpu():
+    """The one-time on-device canary replays every scale's divide and, on an
+    IEEE-correct backend (the CPU suite), keeps the scaled-decimal path on."""
+    codec._decimal_canary_ok = None
+    try:
+        assert codec._scaled_decimal_ok() is True
+        v = np.round(np.random.default_rng(8).uniform(0, 1000, 512) * 100) / 100
+        shrunk = codec.shrink(v, np.dtype(np.float64))
+        assert shrunk is not None and shrunk[1].scale == 100.0
+    finally:
+        codec._decimal_canary_ok = None
+
+
+def test_decimal_canary_failure_falls_back_to_wide_lanes():
+    """A device whose emulated-f64 divide is not bit-exact must NOT use the
+    scaled-decimal carrier: shrink falls back to the f32 round-trip (when
+    exact) or raw f64 — never a representation the device would corrupt."""
+    codec._decimal_canary_ok = False
+    try:
+        # six-digit prices in cents: scaled-decimal would engage (c < 2^31)
+        # but f32 cannot carry them exactly -> must ship as raw float64 (None)
+        v = np.round(np.random.default_rng(9).uniform(1e5, 1e6, 512) * 100) / 100
+        assert codec.shrink(v, np.dtype(np.float64)) is None
+        # dyadic decimals remain f32-exact and take the round-trip carrier
+        s = np.random.default_rng(10).integers(1, 11, 512) / 2.0
+        shrunk = codec.shrink(s, np.dtype(np.float64))
+        assert shrunk is not None and shrunk[0].dtype == np.float32
+        assert shrunk[1].scale == 1.0  # cast path, not a device divide
+        # integral floats keep the cast-only scale-1 carrier (no divide)
+        q = np.random.default_rng(11).integers(1, 51, 512).astype(np.float64)
+        shrunk = codec.shrink(q, np.dtype(np.float64))
+        assert shrunk is not None and shrunk[1].scale == 1.0
+        t = pa.table({"d": s, "q": q})
+        got = roundtrip(t)
+        assert got.column("d").to_pylist() == s.tolist()
+        assert got.column("q").to_pylist() == q.tolist()
+    finally:
+        codec._decimal_canary_ok = None
