@@ -235,7 +235,8 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
             "packed": rec.get("packed", False),
             "grace": rec.get("grace", False),
             "rows_per_s": round(rps)}
-        for k in ("grace_partitions", "grace_pipeline"):
+        for k in ("grace_partitions", "grace_pipeline", "counters",
+                  "warm_h2d_bytes", "peak_hbm_bytes"):
             if k in rec:
                 block["queries"][q][k] = rec[k]
         log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s "

@@ -46,64 +46,83 @@ _CONVERGENCE_COUNTERS = ("jit.miss", "fused.compact_repair",
 # rounds can attribute wins to that path
 _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 
-# out-of-core GRACE adoption (exec/grace.py): per-query route flag, partition
-# count and pipeline A/B flag ride the sweep JSON so BENCH rounds can
-# attribute SF10 numbers to the partitioned tier and compare
-# IGLOO_GRACE_PIPELINE=0/1 runs
-_GRACE_COUNTERS = ("engine.grace_route", "grace.join", "grace.partitions",
-                   "grace.pipeline")
+# per-query counter-delta prefixes recorded into the sweep JSON (cold run):
+# compile cache, packed-key planners, out-of-core tiers, transfer bytes —
+# the trajectory data that lets a BENCH_*.json regression be EXPLAINED
+# (route flip? cache miss? partition-count change?), not just detected
+_DELTA_PREFIXES = ("jit.", "pack.", "grace.", "chunked.", "xfer.",
+                   "cache.", "result_cache.", "engine.", "fused.", "join.")
+
+
+def _peak_hbm_bytes() -> int:
+    """Peak device-memory watermark across local devices; 0 when the backend
+    does not report memory stats (CPU)."""
+    try:
+        import jax
+        peaks = []
+        for d in jax.local_devices():
+            ms = getattr(d, "memory_stats", None)
+            ms = ms() if callable(ms) else None
+            if ms:
+                peaks.append(ms.get("peak_bytes_in_use",
+                                    ms.get("bytes_in_use", 0)))
+        return int(max(peaks)) if peaks else 0
+    except Exception:
+        return 0
 
 
 def run_query(engine, sql: str, trials: int) -> dict:
     """cold -> hint-adoption re-runs -> warm trials -> result-cached run."""
     from igloo_tpu.utils import tracing
-    pack_before = {k: tracing.counters().get(k, 0) for k in _PACK_COUNTERS}
-    grace_before = {k: tracing.counters().get(k, 0) for k in _GRACE_COUNTERS}
-    t0 = time.perf_counter()
-    engine.execute(sql)
-    cold = time.perf_counter() - t0
-    # adopt cardinality hints until the EXECUTION converges: no fresh
-    # compiles and no repair/fallback re-runs. Judging by run TIME plateaus
-    # (the old loop) breaks too early on queries whose adoption cascades a
-    # few rounds at similar cost (q7: three ~10 s adoption rounds before the
-    # 0.5 s steady state — the plateau heuristic bailed after one and the
-    # repairs then fired inside the timed warm trials as a 20x flap)
-    for _ in range(8):
-        snap = tracing.counters()
-        before = {k: snap.get(k, 0) for k in _CONVERGENCE_COUNTERS}
-        engine.result_cache.clear()
-        engine.execute(sql)
-        after = tracing.counters()
-        if all(after.get(k, 0) == before[k] for k in _CONVERGENCE_COUNTERS):
-            break
-    warm = []
-    for _ in range(trials):
-        engine.result_cache.clear()
+    with tracing.counter_delta() as query_delta:
+        with tracing.counter_delta() as cold_delta:
+            t0 = time.perf_counter()
+            engine.execute(sql)
+            cold = time.perf_counter() - t0
+        # adopt cardinality hints until the EXECUTION converges: no fresh
+        # compiles and no repair/fallback re-runs. Judging by run TIME
+        # plateaus (the old loop) breaks too early on queries whose adoption
+        # cascades a few rounds at similar cost (q7: three ~10 s adoption
+        # rounds before the 0.5 s steady state — the plateau heuristic
+        # bailed after one and the repairs then fired inside the timed warm
+        # trials as a 20x flap)
+        for _ in range(8):
+            with tracing.counter_delta() as adopt_delta:
+                engine.result_cache.clear()
+                engine.execute(sql)
+            if all(adopt_delta.get(k) == 0 for k in _CONVERGENCE_COUNTERS):
+                break
+        warm = []
+        with tracing.counter_delta() as warm_delta:
+            for _ in range(trials):
+                engine.result_cache.clear()
+                t0 = time.perf_counter()
+                engine.execute(sql)
+                warm.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         engine.execute(sql)
-        warm.append(time.perf_counter() - t0)
-    t0 = time.perf_counter()
-    engine.execute(sql)
-    cached = time.perf_counter() - t0
-    pack_after = tracing.counters()
+        cached = time.perf_counter() - t0
     rec = {"cold_s": round(cold, 4),
            "warm_trials": [round(w, 4) for w in warm],
            "cached_s": round(cached, 4),
-           "packed": any(pack_after.get(k, 0) > pack_before[k]
-                         for k in _PACK_COUNTERS)}
-    joins = pack_after.get("grace.join", 0) - grace_before["grace.join"]
-    rec["grace"] = pack_after.get("engine.grace_route", 0) > \
-        grace_before["engine.grace_route"]
+           "packed": any(query_delta.get(k) > 0 for k in _PACK_COUNTERS),
+           # cold-run counter deltas (trajectory explanations) + the per-warm
+           # transfer numbers that prove the scan cache amortized uploads
+           "counters": {k: v for k, v in cold_delta.values().items()
+                        if k.startswith(_DELTA_PREFIXES)},
+           "warm_h2d_bytes": warm_delta.get("xfer.h2d_bytes") //
+           max(trials, 1),
+           "peak_hbm_bytes": _peak_hbm_bytes()}
+    joins = query_delta.get("grace.join")
+    rec["grace"] = query_delta.get("engine.grace_route") > 0
     if rec["grace"]:
-        parts = pack_after.get("grace.partitions", 0) - \
-            grace_before["grace.partitions"]
         # per-execution partition count (the query ran several times above)
-        rec["grace_partitions"] = parts // max(joins, 1)
+        rec["grace_partitions"] = query_delta.get("grace.partitions") // \
+            max(joins, 1)
         # whether the double-buffered loop actually RAN (the counter), not
         # just whether the env flag allowed it — recursive-mode and
         # single-partition executions fall back to the serial loop
-        rec["grace_pipeline"] = pack_after.get("grace.pipeline", 0) > \
-            grace_before["grace.pipeline"]
+        rec["grace_pipeline"] = query_delta.get("grace.pipeline") > 0
     return rec
 
 

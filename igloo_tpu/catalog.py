@@ -88,11 +88,26 @@ class Catalog:
 
     def __init__(self):
         self._tables: dict[str, TableProvider] = {}
+        # the `system.` namespace (system.metrics / system.query_log,
+        # igloo_tpu/system_tables.py): resolvable by the binder like any
+        # table but hidden from SHOW TABLES / list_flights, and shielded
+        # from register/deregister so user DDL cannot shadow or drop it
+        self._system: dict[str, TableProvider] = {}
         self._lock = threading.RLock()
 
     def register(self, name: str, provider: TableProvider) -> None:
+        key = name.lower()
+        if key.startswith("system.") or key in ("system",):
+            # the system namespace is read-only by contract: registering a
+            # user table over it would shadow live telemetry silently
+            raise CatalogError(f"cannot register table in the reserved "
+                               f"system namespace: {name}")
         with self._lock:
-            self._tables[name.lower()] = provider
+            self._tables[key] = provider
+
+    def register_system(self, name: str, provider: TableProvider) -> None:
+        with self._lock:
+            self._system[name.lower()] = provider
 
     def deregister(self, name: str) -> None:
         with self._lock:
@@ -101,17 +116,24 @@ class Catalog:
     def get(self, name: str) -> TableProvider:
         with self._lock:
             p = self._tables.get(name.lower())
+            if p is None:
+                p = self._system.get(name.lower())
         if p is None:
             raise CatalogError(f"table not found: {name}")
         return p
 
     def maybe_get(self, name: str) -> Optional[TableProvider]:
         with self._lock:
-            return self._tables.get(name.lower())
+            return self._tables.get(name.lower()) or \
+                self._system.get(name.lower())
 
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._tables)
+
+    def system_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._system)
 
     def __contains__(self, name: str) -> bool:
         with self._lock:

@@ -102,7 +102,9 @@ def main(argv=None) -> int:
     ap.add_argument("--no-jit", action="store_true",
                     help="run kernels eagerly (debugging)")
     ap.add_argument("--timing", action="store_true",
-                    help="print per-stage timing spans")
+                    help="print the per-operator stats tree (rows, wall, "
+                         "compile/execute split, transfer bytes) after each "
+                         "query, plus the raw timing spans")
     ap.add_argument("--warm-cache", nargs="?", const="1", default=None,
                     metavar="SF",
                     help="precompile the TPC-H stage set at the given scale "
@@ -148,14 +150,24 @@ def main(argv=None) -> int:
         runner = client.execute
     else:
         engine = build_engine(cfg, use_jit=not args.no_jit)
-        runner = engine.execute
+        # engine.query keeps the per-query stats (operator tree) beside the
+        # table, so --timing can print what actually executed
+        runner = lambda sql: engine.query(sql)  # noqa: E731
 
     def run_one(sql: str) -> int:
+        from igloo_tpu.engine import QueryResult
+        from igloo_tpu.utils import stats
         try:
             tracing.reset()
             result = runner(sql)
+            qstats = None
+            if isinstance(result, QueryResult):
+                qstats = result.stats
+                result = result.table
             _print_table(result)
             if args.timing:
+                if qstats is not None:
+                    print(stats.render_tree(qstats), file=sys.stderr)
                 print(tracing.last_trace(), file=sys.stderr)
             return 0
         except IglooError as ex:

@@ -120,6 +120,11 @@ class DistributedExecutor:
         # of the reference's never-populated QueryComplete{total_rows,
         # execution_time_ms} (distributed.proto:66-69, SURVEY §5.5)
         self.last_metrics: dict = {}
+        # CUMULATIVE per-worker fragment totals (fragments / rows / seconds /
+        # bytes since coordinator start): the aggregation the coordinator's
+        # `metrics` Flight action exports as labeled Prometheus series
+        self.worker_totals: dict = {}
+        self._totals_lock = threading.Lock()
 
     def execute(self, fragments: list[QueryFragment]) -> pa.Table:
         frags = {f.id: f for f in fragments}
@@ -129,8 +134,12 @@ class DistributedExecutor:
         recoveries = 0
         t_start = time.time()
         # per-QUERY metrics dict: concurrent queries each build their own and
-        # publish atomically at the end (last_metrics = last completed query)
-        metrics: dict = {"fragments": [], "recoveries": 0}
+        # publish atomically at the end (last_metrics = last completed query).
+        # Per-fragment entries attribute wall time to dispatch (RPC + queue)
+        # vs execute (worker-reported) vs dep_fetch (peer transfers); the
+        # query-level recover_s/fetch_s cover re-dispatch and the root fetch.
+        metrics: dict = {"fragments": [], "recoveries": 0,
+                         "recover_s": 0.0, "fetch_s": 0.0}
         try:
             with cf.ThreadPoolExecutor(self.max_parallel) as pool:
                 while pending:
@@ -165,8 +174,12 @@ class DistributedExecutor:
                         if recoveries > self.max_recoveries:
                             raise IglooError(
                                 "giving up after repeated worker failures")
+                        t_rec = time.perf_counter()
                         self._recover(dead, frags, completed, pending)
+                        metrics["recover_s"] += time.perf_counter() - t_rec
+                t_fetch = time.perf_counter()
                 table = self._fetch(completed[root_id], root_id)
+                metrics["fetch_s"] = round(time.perf_counter() - t_fetch, 6)
                 # dedupe by fragment id (a fragment re-run after a worker
                 # death appends twice; last execution wins)
                 by_id: dict = {}
@@ -175,8 +188,10 @@ class DistributedExecutor:
                 metrics["fragments"] = list(by_id.values())
                 metrics.update(
                     total_rows=table.num_rows, recoveries=recoveries,
+                    recover_s=round(metrics["recover_s"], 6),
                     execution_time_s=round(time.time() - t_start, 6))
                 self.last_metrics = metrics  # atomic publish
+                self._accumulate(metrics)
                 return table
         finally:
             self._release(frags, completed, list(frags))
@@ -191,7 +206,16 @@ class DistributedExecutor:
         req = {"id": f.id, "plan": f.plan,
                "deps": [{"id": d, "addr": completed[d]} for d in f.deps]}
         try:
+            t0 = time.perf_counter()
             info = flight_action(f.worker, "execute_fragment", req)
+            wall = time.perf_counter() - t0
+            info["addr"] = f.worker
+            # dispatch = RPC wall minus what the worker accounted for
+            # (execution + dependency fetches): serialization + network +
+            # the worker's action-handler queue
+            info["dispatch_s"] = round(max(
+                wall - info.get("elapsed_s", 0.0)
+                - info.get("dep_fetch_s", 0.0), 0.0), 6)
             metrics["fragments"].append(info)
         except flight.FlightServerError as ex:
             marker = "DEP_UNAVAILABLE:"
@@ -228,6 +252,52 @@ class DistributedExecutor:
 
     def _fetch(self, addr: str, frag_id: str) -> pa.Table:
         return flight_get_table(addr, frag_id)
+
+    def _accumulate(self, metrics: dict) -> None:
+        """Fold one query's per-fragment stats into the cumulative per-worker
+        totals served by the coordinator `metrics` action."""
+        with self._totals_lock:
+            for info in metrics["fragments"]:
+                t = self.worker_totals.setdefault(
+                    info.get("worker", info.get("addr", "?")),
+                    {"fragments": 0, "rows": 0, "execute_s": 0.0,
+                     "dispatch_s": 0.0, "dep_fetch_s": 0.0,
+                     "h2d_bytes": 0, "d2h_bytes": 0, "jit_misses": 0})
+                t["fragments"] += 1
+                t["rows"] += info.get("rows", 0)
+                t["execute_s"] += info.get("elapsed_s", 0.0)
+                t["dispatch_s"] += info.get("dispatch_s", 0.0)
+                t["dep_fetch_s"] += info.get("dep_fetch_s", 0.0)
+                t["h2d_bytes"] += info.get("h2d_bytes", 0) or 0
+                t["d2h_bytes"] += info.get("d2h_bytes", 0) or 0
+                t["jit_misses"] += info.get("jit_misses", 0) or 0
+
+    def prometheus_lines(self) -> list:
+        """Worker-aggregated fragment stats as labeled Prometheus lines."""
+        lines = []
+        with self._totals_lock:
+            totals = {w: dict(t) for w, t in self.worker_totals.items()}
+        for name, key, kind in (
+                ("igloo_coordinator_worker_fragments_total", "fragments",
+                 "counter"),
+                ("igloo_coordinator_worker_fragment_rows_total", "rows", "counter"),
+                ("igloo_coordinator_worker_fragment_execute_seconds_total", "execute_s",
+                 "counter"),
+                ("igloo_coordinator_worker_fragment_dispatch_seconds_total", "dispatch_s",
+                 "counter"),
+                ("igloo_coordinator_worker_fragment_dep_fetch_seconds_total",
+                 "dep_fetch_s", "counter"),
+                ("igloo_coordinator_worker_fragment_h2d_bytes_total", "h2d_bytes",
+                 "counter"),
+                ("igloo_coordinator_worker_fragment_d2h_bytes_total", "d2h_bytes",
+                 "counter"),
+                ("igloo_coordinator_worker_fragment_jit_misses_total", "jit_misses",
+                 "counter")):
+            if totals:
+                lines.append(f"# TYPE {name} {kind}")
+            for w, t in sorted(totals.items()):
+                lines.append(f'{name}{{worker="{w}"}} {t[key]}')
+        return lines
 
     def _release(self, frags: dict[str, QueryFragment],
                  completed: dict[str, str], ids: list[str]) -> None:
@@ -397,6 +467,13 @@ class CoordinatorServer(flight.FlightServerBase):
             }).encode()]
         if action.type == "last_metrics":
             return [json.dumps(self.executor.last_metrics).encode()]
+        if action.type == "metrics":
+            # coordinator process registry + worker-aggregated fragment
+            # stats, Prometheus text (raw bytes — rpc.flight_action_raw)
+            extra = ["# TYPE igloo_workers_live gauge",
+                     f"igloo_workers_live {len(self.membership.live())}"]
+            extra.extend(self.executor.prometheus_lines())
+            return [tracing.prometheus_text(extra_lines=extra).encode()]
         if action.type == "ping":
             return [json.dumps({"workers": len(self.membership.live())}).encode()]
         if action.type == "poll_flight_info":
@@ -413,6 +490,8 @@ class CoordinatorServer(flight.FlightServerBase):
                 ("register_table", "register a table from a provider spec"),
                 ("cluster_status", "membership + catalog snapshot"),
                 ("last_metrics", "per-fragment metrics of the last query"),
+                ("metrics", "process + worker-aggregated fragment metrics, "
+                            "Prometheus text format"),
                 ("ping", "liveness"),
                 ("poll_flight_info",
                  "PollFlightInfo equivalent: serialized FlightInfo for a "

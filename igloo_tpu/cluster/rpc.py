@@ -132,6 +132,14 @@ def normalize(addr: str) -> str:
 def flight_action(addr: str, name: str, payload: Optional[dict] = None) -> dict:
     """One-shot action RPC: connect, act, close. Returns the decoded first
     result (or {})."""
+    body = flight_action_raw(addr, name, payload)
+    return json.loads(body) if body else {}
+
+
+def flight_action_raw(addr: str, name: str,
+                      payload: Optional[dict] = None) -> bytes:
+    """One-shot action RPC returning the raw first-result bytes — for
+    actions whose payload is NOT JSON (the `metrics` Prometheus text)."""
     client = flight.connect(normalize(addr))
     try:
         body = json.dumps(payload).encode() if payload is not None else b""
@@ -139,7 +147,7 @@ def flight_action(addr: str, name: str, payload: Optional[dict] = None) -> dict:
                                         call_options()))
     finally:
         client.close()
-    return json.loads(results[0].body.to_pybytes()) if results else {}
+    return results[0].body.to_pybytes() if results else b""
 
 
 def flight_get_table(addr: str, ticket: str):

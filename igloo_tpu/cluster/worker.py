@@ -116,19 +116,29 @@ class WorkerServer(flight.FlightServerBase):
     def _execute_fragment(self, req: dict) -> dict:
         frag_id = req["id"]
         overlay: dict = {}
+        t_dep0 = time.perf_counter()
         for dep in req.get("deps", []):
             t = self._fetch_dep(dep["id"], dep["addr"])
             overlay[(FRAG_PREFIX + dep["id"]).lower()] = MemTable(t)
+        dep_s = time.perf_counter() - t_dep0
         catalog = _OverlayCatalog(self._catalog, overlay)
         plan = serde.plan_from_json(req["plan"], catalog)
         t0 = time.perf_counter()
-        table = self._executor().execute_to_arrow(plan)
+        # per-fragment counter delta: thread-isolated, so concurrent
+        # fragments on this worker report only their own transfers/compiles
+        with tracing.counter_delta() as delta:
+            table = self._executor().execute_to_arrow(plan)
         elapsed = time.perf_counter() - t0
         with self._lock:
             self._results[frag_id] = table
         tracing.counter("worker.fragments")
         return {"id": frag_id, "rows": table.num_rows,
-                "elapsed_s": round(elapsed, 6), "worker": self.worker_id}
+                "elapsed_s": round(elapsed, 6), "worker": self.worker_id,
+                "dep_fetch_s": round(dep_s, 6),
+                "h2d_bytes": delta.get("xfer.h2d_bytes"),
+                "d2h_bytes": delta.get("xfer.d2h_bytes"),
+                "jit_misses": delta.get("jit.miss"),
+                "cache_hits": delta.get("cache.hit")}
 
     # --- Flight surface ---
 
@@ -155,13 +165,18 @@ class WorkerServer(flight.FlightServerBase):
             return [json.dumps({"worker": self.worker_id,
                                 "tables": sorted(self._catalog.names()),
                                 "fragments": len(self._results)}).encode()]
+        if action.type == "metrics":
+            # Prometheus text exposition of this worker process's registry
+            # (raw bytes, not JSON — scrape via rpc.flight_action_raw)
+            return [tracing.prometheus_text().encode()]
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
         return [("execute_fragment", "execute a serialized plan fragment"),
                 ("register_table", "register a table from a provider spec"),
                 ("release", "drop cached fragment results"),
-                ("ping", "liveness + status")]
+                ("ping", "liveness + status"),
+                ("metrics", "process metrics, Prometheus text format")]
 
     def do_get(self, context, ticket):
         frag_id = ticket.ticket.decode()

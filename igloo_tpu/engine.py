@@ -27,7 +27,7 @@ from igloo_tpu.plan.binder import Binder
 from igloo_tpu.plan.optimizer import optimize
 from igloo_tpu.sql import ast as A
 from igloo_tpu.sql.parser import parse_sql
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import stats, tracing
 from igloo_tpu.utils.tracing import span
 
 
@@ -47,6 +47,9 @@ class QueryResult:
     table: pa.Table
     plan: Optional[L.LogicalPlan] = None
     elapsed_s: float = 0.0
+    # per-query telemetry (operator tree, tier, transfer bytes, counter
+    # deltas) — populated for SELECT and EXPLAIN ANALYZE
+    stats: Optional[stats.QueryStats] = None
 
     @property
     def num_rows(self) -> int:
@@ -111,6 +114,10 @@ class QueryEngine:
         self.host_cache = BatchCache(cache_budget_bytes)
         # reference parity: capitalize registered at construction (lib.rs:41-42)
         self.register_udf(UdfDef("capitalize", T.STRING))
+        # SQL-queryable telemetry: SELECT * FROM system.metrics /
+        # system.query_log through the normal engine path (system_tables.py)
+        from igloo_tpu.system_tables import register_system_tables
+        register_system_tables(self.catalog)
 
     # --- registration ---
 
@@ -166,26 +173,28 @@ class QueryEngine:
             bound = Binder(self.catalog, udfs=self.udfs).bind(stmt.query)
             plan = optimize(bound)
             text = L.plan_tree_str(plan)
+            qs = None
             if stmt.analyze:
                 # EXPLAIN ANALYZE executes through the SAME routing ladder as
-                # a real query (host / chunked / GRACE / normal) and surfaces
-                # the out-of-core phase breakdown when GRACE ran
-                c0 = tracing.counters()
-                t1 = time.perf_counter()
-                self._execute_plan(plan)
-                text += f"\n-- execution: {time.perf_counter() - t1:.4f}s"
-                c1 = tracing.counters()
-                nparts = c1.get("grace.partitions", 0) - \
-                    c0.get("grace.partitions", 0)
+                # a real query (host / chunked / GRACE / normal), with stats
+                # collection in DETAIL mode: actual per-operator row counts,
+                # per-node wall time, compile/execute split, transfer bytes,
+                # and GRACE per-partition rollups (docs/observability.md)
+                with stats.collect(sql, detail=True) as qs:
+                    table = self._execute_plan(plan)
+                    qs.rows = table.num_rows
+                text += "\n-- actual (operator tree):\n"
+                text += stats.render_tree(qs)
+                delta = qs.counters
+                nparts = delta.get("grace.partitions", 0)
                 if nparts:
                     text += f"\n-- grace.partitions: {nparts}"
                 for ph in ("partition", "join", "merge"):
-                    ms = c1.get(f"grace.{ph}_ms", 0) - \
-                        c0.get(f"grace.{ph}_ms", 0)
+                    ms = delta.get(f"grace.{ph}_ms", 0)
                     if ms:
                         text += f"\n-- grace.{ph}_s: {ms / 1000:.3f}"
             return QueryResult(pa.table({"plan": text.split("\n")}), plan=plan,
-                               elapsed_s=time.perf_counter() - t0)
+                               elapsed_s=time.perf_counter() - t0, stats=qs)
         if isinstance(stmt, A.CreateTableAsStmt):
             res = self._run_select(stmt.query)
             self.register_table(stmt.name, MemTable(res))
@@ -201,9 +210,11 @@ class QueryEngine:
             return QueryResult(pa.table({"status": [f"dropped {stmt.name}"]}),
                                elapsed_s=time.perf_counter() - t0)
         if isinstance(stmt, A.SelectStmt):
-            table, plan = self._run_select(stmt, want_plan=True)
+            with stats.collect(sql) as qs:
+                table, plan = self._run_select(stmt, want_plan=True)
+                qs.rows = table.num_rows
             return QueryResult(table, plan=plan,
-                               elapsed_s=time.perf_counter() - t0)
+                               elapsed_s=time.perf_counter() - t0, stats=qs)
         raise IglooError(f"unsupported statement {type(stmt).__name__}")
 
     def _resolve_mesh(self):
@@ -245,6 +256,7 @@ class QueryEngine:
         sharded executor already bounds per-chip memory by row-sharding, and
         silently chunking would discard the parallelism."""
         from igloo_tpu.exec.chunked import LocalChunkExecutor, chunk_count
+        qs = stats.current()
         if self._host_route(plan):
             from igloo_tpu.exec.host import HostExecutor, HostUnsupported
             try:
@@ -253,6 +265,8 @@ class QueryEngine:
                         self.catalog,
                         scan_cache=self.host_cache).execute_to_arrow(plan)
                 tracing.counter("engine.host_route")
+                if qs is not None:
+                    qs.tier = "host"
                 return table
             except HostUnsupported as e:
                 tracing.counter("engine.host_route_unsupported")
@@ -273,6 +287,8 @@ class QueryEngine:
         with span("execute"):
             if chunks:
                 tracing.counter("engine.chunked_route")
+                if qs is not None:
+                    qs.tier = "chunked"
                 return LocalChunkExecutor(
                     self.catalog, self._jit_cache, use_jit=self._use_jit,
                     batch_cache=self.batch_cache,
@@ -280,11 +296,15 @@ class QueryEngine:
             if grace_found:
                 from igloo_tpu.exec.grace import GraceJoinExecutor
                 tracing.counter("engine.grace_route")
+                if qs is not None:
+                    qs.tier = "grace"
                 return GraceJoinExecutor(
                     self.catalog, self._jit_cache, use_jit=self._use_jit,
                     batch_cache=self.batch_cache, hints=self.hint_store,
                     budget_bytes=self.chunk_budget_bytes,
                 ).execute_to_arrow(plan, grace_found)
+            if qs is not None:
+                qs.tier = "sharded" if mesh is not None else "device"
             return self._executor().execute_to_arrow(plan)
 
     def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
@@ -296,6 +316,9 @@ class QueryEngine:
         if rkey is not None:
             hit = self.result_cache.get(rkey)
             if hit is not None:
+                qs = stats.current()
+                if qs is not None:
+                    qs.tier = "result_cache"
                 return (hit, plan) if want_plan else hit
         table = self._execute_plan(plan)
         if rkey is not None:

@@ -26,6 +26,7 @@ from igloo_tpu.exec import kernels as K
 from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn, DictInfo
 from igloo_tpu.exec.expr_compile import Compiled, Env
 from igloo_tpu.plan.expr import AggFunc
+from igloo_tpu.utils import tracing
 
 
 @dataclass(frozen=True)
@@ -99,6 +100,7 @@ def seg_dims_for(groups: list[Compiled],
             return None
         if input_capacity is None or prod > 2 * input_capacity:
             return None
+    tracing.counter("agg.direct_scatter")
     return tuple(dims)
 
 

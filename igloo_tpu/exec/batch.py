@@ -496,6 +496,8 @@ def to_arrow(batch: DeviceBatch) -> pa.Table:
     host_live, host_vals, host_nulls = jax.device_get(
         (batch.live, [c.values for c in batch.columns],
          [c.nulls for c in batch.columns]))
+    from igloo_tpu.utils.stats import record_fetch
+    record_fetch((host_live, host_vals, host_nulls))
     return arrow_from_host(batch, host_live, host_vals, host_nulls)
 
 

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from igloo_tpu.exec.batch import DeviceBatch
+from igloo_tpu.utils import stats
 from igloo_tpu.utils.tracing import counter
 
 
@@ -70,6 +71,9 @@ class SnapshotLRU:
             self._entries.move_to_end(key)
             self.hits += 1
             counter(f"{self.counter_prefix}.hit")
+            # per-operator attribution in the query stats tree (a scan node
+            # served from HBM shows cache_hit=N instead of upload bytes)
+            stats.bump_attr(f"{self.counter_prefix}_hit")
             return e.value
 
     def put(self, key, value, snapshot: object, nbytes: int,

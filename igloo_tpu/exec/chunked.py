@@ -28,7 +28,7 @@ from typing import Optional
 import pyarrow as pa
 
 from igloo_tpu.plan import logical as L
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import stats, tracing
 
 
 def estimated_bytes(provider) -> Optional[int]:
@@ -128,9 +128,17 @@ class LocalChunkExecutor:
         overlay = _Overlay()
         # fragments are appended children-first, so sequential order is
         # dependency-safe; chunk results are host Arrow (partials are small)
-        for f in frags:
-            p = serde.plan_from_json(f.plan, overlay)
-            ex = Executor(self._jit_cache, use_jit=self._use_jit,
-                          batch_cache=self._batch_cache)
-            results[f.id] = ex.execute_to_arrow(p)
-        return results[frags[-1].id]
+        with stats.op("ChunkedExecution", chunks=self.chunks,
+                      fragments=len(frags)):
+            for i, f in enumerate(frags):
+                p = serde.plan_from_json(f.plan, overlay)
+                ex = Executor(self._jit_cache, use_jit=self._use_jit,
+                              batch_cache=self._batch_cache)
+                with stats.op(f"Chunk[{i}]" if i < len(frags) - 1
+                              else "ChunkMerge"):
+                    results[f.id] = ex.execute_to_arrow(p)
+                    # host Arrow row count — free, no device sync
+                    stats.set_rows(results[f.id].num_rows)
+            out = results[frags[-1].id]
+            stats.set_rows(out.num_rows)
+        return out

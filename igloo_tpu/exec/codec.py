@@ -230,8 +230,15 @@ def upload_columns(plans: list, device=None) -> list:
     and the carrier is zero-padded — a dead lane therefore widens to the
     spec's offset, which is 0 on every path except offset-shrink. Returns the
     device arrays in the engine lane dtypes, order preserved."""
-    put = (jnp.asarray if device is None
-           else functools.partial(jax.device_put, device=device))
+    raw_put = (jnp.asarray if device is None
+               else functools.partial(jax.device_put, device=device))
+    h2d = 0
+
+    def put(a):
+        nonlocal h2d
+        h2d += getattr(a, "nbytes", 0)
+        return raw_put(a)
+
     out: list = [None] * len(plans)
     widen_idx: list[int] = []
     widen_specs: list[WidenSpec] = []
@@ -255,6 +262,8 @@ def upload_columns(plans: list, device=None) -> list:
             widen_arrs, scales, offsets)
         for i, w in zip(widen_idx, wide):
             out[i] = w
+    from igloo_tpu.utils.stats import record_upload
+    record_upload(h2d)  # actual shipped bytes: narrowed carriers, padded
     return out
 
 

@@ -17,6 +17,7 @@ reuse executables across QueryEngine.execute calls.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 import jax
@@ -46,7 +47,7 @@ from igloo_tpu.exec.sort_limit import limit_batch, sort_batch
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import stats, tracing
 
 _SHRINK_FACTOR = 4  # shrink a batch when capacity > factor * needed
 
@@ -129,6 +130,30 @@ def col_meta(cols) -> tuple[list, list]:
     return [c.dictionary for c in cols], [c.bounds for c in cols]
 
 
+# per-query D2H accounting at the executor's fetch sites
+record_fetch = stats.record_fetch
+
+
+class _CompileTimed:
+    """One-shot wrapper returned by `_jitted` on a cache miss when a query is
+    being collected: times the FIRST call (where jax traces, lowers and
+    compiles synchronously before dispatch) and attributes it to the current
+    operator as compile time. Never cached — later calls get the raw fn."""
+    __slots__ = ("fn",)
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, *args, **kw):
+        t0 = time.perf_counter()
+        try:
+            return self.fn(*args, **kw)
+        finally:
+            dt = time.perf_counter() - t0
+            stats.record_compile(dt)
+            tracing.histogram("compile.first_call_s", dt)
+
+
 class Executor:
     # Speculative join expand: when both inputs fit the budget, expand with
     # capacity max(left, right) WITHOUT syncing on the exact candidate total.
@@ -163,6 +188,7 @@ class Executor:
         fn = self._cache.get(key)
         if fn is None:
             tracing.counter("jit.miss")
+            stats.bump_attr("jit_miss")
             if _LOG_COMPILES:
                 import sys
                 print(f"igloo-compile: {kind} "
@@ -173,8 +199,13 @@ class Executor:
             if self._use_jit:
                 fn = jax.jit(fn, static_argnums=static_argnums)
             self._cache[key] = fn
+            if stats.current() is not None:
+                # the raw fn is what got cached; the wrapper lives for this
+                # one first call and books it as the node's compile cost
+                return _CompileTimed(fn)
         else:
             tracing.counter("jit.hit")
+            stats.bump_attr("jit_hit")
         return fn
 
     # --- entry ---
@@ -183,10 +214,10 @@ class Executor:
         batch = self._exec(plan)
         if self._deferred_overflow or self._deferred_stats:
             deferred, self._deferred_overflow = self._deferred_overflow, []
-            stats, self._deferred_stats = self._deferred_stats, []
+            stat_pairs, self._deferred_stats = self._deferred_stats, []
             vals, svals = jax.device_get(
-                ([f for _, f in deferred], [v for _, v in stats]))
-            self._record_stats(stats, svals)
+                ([f for _, f in deferred], [v for _, v in stat_pairs]))
+            self._record_stats(stat_pairs, svals)
             if self._fired_deferred(deferred, vals):
                 return self._exact_copy().execute(plan)
         return batch
@@ -240,7 +271,11 @@ class Executor:
     _FUSE = True
 
     def execute_to_arrow(self, plan: L.LogicalPlan) -> pa.Table:
-        if self._FUSE and self._use_jit and self._speculate:
+        # detail-mode stats (EXPLAIN ANALYZE) route to the staged executor:
+        # the fused program is ONE dispatch with no internal operator
+        # boundaries, so per-operator rows/timings only exist staged
+        if self._FUSE and self._use_jit and self._speculate and \
+                not stats.detail_active():
             try:
                 return self._fused_to_arrow(plan)
             except FusionUnsupported as e:
@@ -255,9 +290,14 @@ class Executor:
         overflow triggers ONE repair re-run with the fresh hints, any other
         flag (direct-join duplicates, speculative overflow) an exact staged
         re-run. Oversized results pay an exact compact + full fetch."""
+        with stats.op("FusedProgram" if _retry else "FusedProgram(repair)"):
+            return self._fused_run(plan, _retry)
+
+    def _fused_run(self, plan: L.LogicalPlan, _retry: bool) -> pa.Table:
         from igloo_tpu.exec.batch import arrow_from_host
         comp = FusedCompiler(self)
         run, key, meta = comp.compile(plan)
+        stats.annotate(nodes=len(comp.fps), leaves=len(comp.leaves))
         # `nofuse` sentinel: armed in the persistent store before a
         # first-in-process fused compile, cleared on success. A process killed
         # mid-compile (pathological XLA compiles run 20+ min on some fused
@@ -276,7 +316,7 @@ class Executor:
         jf = self._jitted("fused", key, lambda: run)
         tracing.counter("fused.execute")
         try:
-            big, spec, n_dev, flags, stats = jf(
+            big, spec, n_dev, flags, stats_dev = jf(
                 [strip_dicts(b) for b in comp.leaves],
                 comp.pool.device_args())
         except BaseException:
@@ -291,8 +331,11 @@ class Executor:
             self._hints.remove(sentinel)
             self._hints.flush()
         flags_h, stats_h, n, host_live, host_vals, host_nulls = jax.device_get(
-            (flags, stats, n_dev, spec.live, [c.values for c in spec.columns],
+            (flags, stats_dev, n_dev, spec.live,
+             [c.values for c in spec.columns],
              [c.nulls for c in spec.columns]))
+        record_fetch((host_live, host_vals, host_nulls))
+        stats.set_rows(int(n))
         for sid, v in stats_h.items():
             self._cache[("nhint", comp.stat_keys[sid])] = int(v)
             if self._hints is not None:
@@ -331,16 +374,17 @@ class Executor:
         from igloo_tpu.exec.batch import arrow_from_host
         batch = self._exec(plan)
         deferred, self._deferred_overflow = self._deferred_overflow, []
-        stats, self._deferred_stats = self._deferred_stats, []
+        stat_pairs, self._deferred_stats = self._deferred_stats, []
         dvals = [f for _, f in deferred]
-        dstats = [v for _, v in stats]
+        dstats = [v for _, v in stat_pairs]
         cap = self._FINAL_FETCH_CAPACITY
         if batch.capacity <= cap:
             flags, svals, host_live, host_vals, host_nulls = jax.device_get(
                 (dvals, dstats, batch.live,
                  [c.values for c in batch.columns],
                  [c.nulls for c in batch.columns]))
-            self._record_stats(stats, svals)
+            record_fetch((host_live, host_vals, host_nulls))
+            self._record_stats(stat_pairs, svals)
             if self._fired_deferred(deferred, flags):
                 return self._exact_copy().execute_to_arrow(plan)
             return arrow_from_host(batch, host_live, host_vals, host_nulls)
@@ -358,7 +402,8 @@ class Executor:
                 (dvals, dstats, n_dev, spec.live,
                  [c.values for c in spec.columns],
                  [c.nulls for c in spec.columns]))
-        self._record_stats(stats, svals)
+        record_fetch((host_live, host_vals, host_nulls))
+        self._record_stats(stat_pairs, svals)
         if self._fired_deferred(deferred, flags):
             return self._exact_copy().execute_to_arrow(plan)
         if int(host_n) <= cap:
@@ -378,7 +423,12 @@ class Executor:
         m = getattr(self, "_exec_" + type(plan).__name__.lower(), None)
         if m is None:
             raise NotSupportedError(f"no executor for {type(plan).__name__}")
-        out = m(plan)
+        with stats.plan_op(plan):
+            out = m(plan)
+            if stats.detail_active():
+                # EXPLAIN ANALYZE: actual row count, one device sync per op
+                stats.set_rows(out.num_live())
+                stats.annotate(capacity=out.capacity)
         if out.schema is not plan.schema and out.schema != plan.schema:
             # keep plan schema authoritative (names may differ from kernel output)
             out = DeviceBatch(plan.schema, out.columns, out.live)
@@ -603,6 +653,9 @@ class Executor:
             pack_spec = K.plan_group_packing(groups, comp.pool)
             if pack_spec is not None:
                 tracing.counter("pack.agg")
+        stats.annotate(strategy="direct_scatter" if seg_dims is not None
+                       else "packed_sort" if pack_spec is not None
+                       else "lex_sort")
         fp = ("agg", expr_fingerprint(gres + ares),
               tuple((a.func, a.dtype) for a in aggs),
               batch_proto_key(batch), out_schema,
@@ -904,6 +957,7 @@ class Executor:
                     (fpbase, plan.schema, side, blo, tsize, ki, want),
                     build)
                 tracing.counter("join.direct")
+                stats.annotate(strategy="direct", build_side=side)
                 out, dup, n_dev, ovf = fn(
                     rs if swapped else ls, ls if swapped else rs, consts)
                 self._deferred_overflow.append(
@@ -939,6 +993,7 @@ class Executor:
                     jt is JoinType.ANTI, residual, win, consts,
                     pack_eq=pack_eq)))
             tracing.counter("join.semi_sorted")
+            stats.annotate(strategy="semi_sorted")
             out, truncated = fn(ls, rs, consts)
             if residual is not None:
                 self._deferred_overflow.append(
@@ -948,6 +1003,7 @@ class Executor:
             return attach_dicts(out, dicts[: len(out.columns)],
                                 bnds[: len(out.columns)])
 
+        stats.annotate(strategy="sorted_probe")
         probe = self._jitted(
             "join_probe", fpbase,
             lambda: (lambda l, r, consts: probe_phase(

@@ -143,6 +143,10 @@ class FusedCompiler:
 
     def compile(self, plan: L.LogicalPlan):
         fn, meta = self._c(plan)
+        # program-shape telemetry: how many plan nodes one dispatch covers
+        # (the whole point of fusion) — system.metrics hist_max shows the
+        # largest program this process compiled
+        tracing.histogram("fused.nodes", len(self.fps))
         fetch_cap = self.FETCH_CAPACITY
 
         def run(leaves, consts):

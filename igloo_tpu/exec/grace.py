@@ -73,7 +73,7 @@ from igloo_tpu import types as T
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import stats, tracing
 
 # sanity clamp only — the real partition count is derived from the budget;
 # past this the host-side bucket bookkeeping dominates and the clamp is
@@ -82,6 +82,10 @@ from igloo_tpu.utils import tracing
 MAX_GRACE_PARTITIONS = 1024
 # recursive re-partitioning levels (level 0 = the outer GRACE execution)
 MAX_GRACE_DEPTH = 3
+# EXPLAIN ANALYZE records full operator subtrees for this many partitions;
+# the rest contribute to the per-partition ROLLUP only (a 1024-partition
+# query must not materialize 1024 stats subtrees)
+DETAIL_PARTITIONS = 4
 
 _INTERIOR_JOINS = (JoinType.INNER, JoinType.SEMI, JoinType.ANTI)
 
@@ -485,6 +489,12 @@ class GraceJoinExecutor:
 
     def execute_to_arrow(self, plan: L.LogicalPlan, found: GracePlan,
                          depth: int = 0) -> pa.Table:
+        with stats.op("GraceJoin", partitions=found.n_parts,
+                      depth=depth) as gnode:
+            return self._execute(plan, found, depth, gnode)
+
+    def _execute(self, plan: L.LogicalPlan, found: GracePlan,
+                 depth: int, gnode) -> pa.Table:
         from igloo_tpu.catalog import MemTable
         from igloo_tpu.cluster.fragment import (
             decompose_aggregate, final_merge_plan, partial_aggregate_node,
@@ -500,19 +510,22 @@ class GraceJoinExecutor:
             t0 = time.perf_counter()
             parted: dict[int, list[pa.Table]] = {}
             rep_prov: dict[int, object] = {}
-            for leaf in gp.leaves:
-                if leaf.key_col is not None:
-                    parted[leaf.index] = self._partition_leaf(
-                        leaf, gp.n_parts, depth)
-                    used_names.append(f"__grace_p{leaf.index}")
-                else:
-                    tbl = self._leaf_to_arrow(leaf.node, depth)
-                    # sliceable provider partitions so a RECURSIVE grace level
-                    # can stream this table instead of device-reading it whole
-                    parts = max(1, -(-tbl.nbytes // max(self.budget_bytes, 1)))
-                    rep_prov[leaf.index] = _stamp_snapshot(
-                        MemTable(tbl, partitions=parts))
-                    used_names.append(f"__grace_rep{leaf.index}")
+            with stats.op("GracePhase(partition)"):
+                for leaf in gp.leaves:
+                    if leaf.key_col is not None:
+                        parted[leaf.index] = self._partition_leaf(
+                            leaf, gp.n_parts, depth)
+                        used_names.append(f"__grace_p{leaf.index}")
+                    else:
+                        tbl = self._leaf_to_arrow(leaf.node, depth)
+                        # sliceable provider partitions so a RECURSIVE grace
+                        # level can stream this table instead of
+                        # device-reading it whole
+                        parts = max(
+                            1, -(-tbl.nbytes // max(self.budget_bytes, 1)))
+                        rep_prov[leaf.index] = _stamp_snapshot(
+                            MemTable(tbl, partitions=parts))
+                        used_names.append(f"__grace_rep{leaf.index}")
             tracing.counter("grace.partition_ms",
                             int(1000 * (time.perf_counter() - t0)))
 
@@ -592,46 +605,90 @@ class GraceJoinExecutor:
             pipeline = os.environ.get("IGLOO_GRACE_PIPELINE", "1") != "0" \
                 and not recursive_mode and len(run_ps) > 1
             partials: list[pa.Table] = []
-            if pipeline:
-                tracing.counter("grace.pipeline")
-                from concurrent.futures import ThreadPoolExecutor
-                with ThreadPoolExecutor(max_workers=1) as pool:
-                    fut = pool.submit(prepare, run_ps[0])
+            part_rows: list[int] = []
+            part_wall: list[float] = []
+
+            def run_partition(k: int, p: int, provs: dict) -> None:
+                """One partition's plan on device; rows (host Arrow — free)
+                and wall feed the per-partition rollup. The first few
+                partitions keep full operator subtrees under EXPLAIN
+                ANALYZE; the rest are recorded quiet (rollup only)."""
+                tp = time.perf_counter()
+                keep = stats.detail_active() and k < DETAIL_PARTITIONS
+                cm = stats.op(f"Partition[{p}]") if keep else stats.quiet()
+                with cm:
+                    tbl = self._leaf_routed(build_sub(provs), depth)
+                    if keep:
+                        stats.set_rows(tbl.num_rows)
+                partials.append(tbl)
+                part_rows.append(tbl.num_rows)
+                part_wall.append(time.perf_counter() - tp)
+
+            with stats.op("GracePhase(join)"):
+                if pipeline:
+                    tracing.counter("grace.pipeline")
+                    from concurrent.futures import ThreadPoolExecutor
+                    # the prefetch thread adopts this query's stats context
+                    # so its uploads/counters land in the right deltas
+                    sctx = stats.capture()
+
+                    def prepare_traced(p: int) -> dict:
+                        with stats.adopt(sctx):
+                            return prepare(p)
+
+                    with ThreadPoolExecutor(max_workers=1) as pool:
+                        fut = pool.submit(prepare_traced, run_ps[0])
+                        for k, p in enumerate(run_ps):
+                            provs = fut.result()
+                            if k + 1 < len(run_ps):
+                                fut = pool.submit(prepare_traced,
+                                                  run_ps[k + 1])
+                            run_partition(k, p, provs)
+                else:
                     for k, p in enumerate(run_ps):
-                        provs = fut.result()
-                        if k + 1 < len(run_ps):
-                            fut = pool.submit(prepare, run_ps[k + 1])
-                        partials.append(
-                            self._leaf_routed(build_sub(provs), depth))
-            else:
-                for p in run_ps:
-                    partials.append(
-                        self._leaf_routed(build_sub(prepare(p)), depth))
+                        run_partition(k, p, prepare(p))
             tracing.counter("grace.join_ms",
                             int(1000 * (time.perf_counter() - t0)))
+            if gnode is not None:
+                gnode.attrs.update(
+                    partitions_run=len(run_ps),
+                    partitions_skipped=gp.n_parts - len(run_ps),
+                    pipeline=bool(pipeline))
+                if part_rows:
+                    gnode.attrs["partition_rows"] = (
+                        f"min={min(part_rows)}/"
+                        f"avg={sum(part_rows) // len(part_rows)}/"
+                        f"max={max(part_rows)}")
+                    gnode.attrs["partition_ms"] = (
+                        f"min={1e3 * min(part_wall):.1f}/"
+                        f"avg={1e3 * sum(part_wall) / len(part_wall):.1f}/"
+                        f"max={1e3 * max(part_wall):.1f}")
 
             # --- merge -------------------------------------------------------
             t0 = time.perf_counter()
-            if gp.agg is not None:
-                merged_tbl = pa.concat_tables(partials) if partials else \
-                    partial_schema_empty(partial_schema)
-                merged_scan = _mem_scan("__grace_partials",
-                                        _stamp_snapshot(MemTable(merged_tbl)),
-                                        partial_schema)
-                top = final_merge_plan(gp.agg, merged_scan, final_spec)
-                upper = gp.path[: gp.path.index(gp.agg)]
-                used_names.append("__grace_partials")
-            else:
-                out_tbl = pa.concat_tables(partials) if partials else \
-                    tbl_empty_like(gp.root.schema)
-                top = _mem_scan("__grace_joined",
-                                _stamp_snapshot(MemTable(out_tbl)),
-                                gp.root.schema)
-                upper = gp.path
-                used_names.append("__grace_joined")
-            for nd in reversed(upper):
-                top = _rewire(nd, top)
-            out = self._executor().execute_to_arrow(top)
+            with stats.op("GracePhase(merge)"):
+                if gp.agg is not None:
+                    merged_tbl = pa.concat_tables(partials) if partials else \
+                        partial_schema_empty(partial_schema)
+                    merged_scan = _mem_scan(
+                        "__grace_partials",
+                        _stamp_snapshot(MemTable(merged_tbl)),
+                        partial_schema)
+                    top = final_merge_plan(gp.agg, merged_scan, final_spec)
+                    upper = gp.path[: gp.path.index(gp.agg)]
+                    used_names.append("__grace_partials")
+                else:
+                    out_tbl = pa.concat_tables(partials) if partials else \
+                        tbl_empty_like(gp.root.schema)
+                    top = _mem_scan("__grace_joined",
+                                    _stamp_snapshot(MemTable(out_tbl)),
+                                    gp.root.schema)
+                    upper = gp.path
+                    used_names.append("__grace_joined")
+                for nd in reversed(upper):
+                    top = _rewire(nd, top)
+                out = self._executor().execute_to_arrow(top)
+                stats.set_rows(out.num_rows)
             tracing.counter("grace.merge_ms",
                             int(1000 * (time.perf_counter() - t0)))
             return out

@@ -38,7 +38,7 @@ from igloo_tpu.exec.batch import DictInfo, host_decode_column
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import stats, tracing
 
 
 class HostUnsupported(Exception):
@@ -183,8 +183,15 @@ class HostExecutor:
                 served = _serve_by_name(hit, plan.schema)
                 if served is not None:
                     tracing.counter("host.memo_hit")
+                    with stats.plan_op(plan):
+                        stats.set_rows(served.n)
+                        stats.annotate(memo="hit")
                     return served
-        out = m(plan)
+        with stats.plan_op(plan):
+            out = m(plan)
+            # numpy row counts are host values: actual rows are FREE on this
+            # tier, recorded at every collection level
+            stats.set_rows(out.n)
         if out.schema is not plan.schema and out.schema != plan.schema:
             out = HBatch(plan.schema, out.cols, out.n)
         if key is not None and (key not in self._memo or

@@ -33,6 +33,7 @@ from igloo_tpu.exec import kernels as K
 from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn, round_capacity
 from igloo_tpu.exec.expr_compile import Compiled, Env
 from igloo_tpu.sql.ast import JoinType
+from igloo_tpu.utils import tracing
 
 
 @dataclass
@@ -515,9 +516,11 @@ def choose_direct_build(lks: list, rks: list, left_cap: int,
             if rng <= DIRECT_RANGE_BUDGET and cap <= 2 * rng:
                 options.append((cap, rng, side, (int(b[0]), int(b[1])), i))
     if not options:
+        tracing.counter("join.direct_ineligible")
         return None
     options.sort(key=lambda o: (o[0], o[1], o[2], o[4]))
     _, _, side, bounds, idx = options[0]
+    tracing.counter("join.direct_eligible")
     return side, bounds, idx
 
 

@@ -1,0 +1,130 @@
+"""SQL-queryable telemetry: the `system.metrics` and `system.query_log`
+tables.
+
+Both are ordinary TableProviders registered in every QueryEngine's catalog
+under the `system.` namespace (Catalog.register_system — resolvable by the
+binder, hidden from SHOW TABLES), so `SELECT * FROM system.metrics` runs
+through the normal parse -> bind -> optimize -> execute path like any other
+query. Their snapshot token is the metrics registry's mutation version, so
+the engine's scan/result caches invalidate exactly when telemetry changed —
+a repeated SELECT always sees live numbers.
+
+Schemas are documented in docs/observability.md; changing them is a
+documented-contract change, not a refactor.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+
+from igloo_tpu.exec.batch import schema_from_arrow
+from igloo_tpu.utils import stats, tracing
+
+
+class _SystemTable:
+    """Shared provider shell: in-memory snapshot tables, snapshot-versioned
+    by the metrics registry so caches never serve stale telemetry."""
+
+    # row order within one snapshot is deterministic, but the column-granular
+    # scan cache must not stitch columns from DIFFERENT snapshots into one
+    # batch — the whole-batch path (stable_row_order=False) reads atomically
+    stable_row_order = False
+
+    _arrow_schema: pa.Schema = None  # set by subclass
+
+    def __deepcopy__(self, memo):
+        return self
+
+    def schema(self):
+        return schema_from_arrow(self._arrow_schema)
+
+    def snapshot(self) -> int:
+        return tracing.REGISTRY.version()
+
+    def _build(self) -> pa.Table:
+        raise NotImplementedError
+
+    def read(self, projection: Optional[list] = None,
+             filters: Optional[list] = None) -> pa.Table:
+        t = self._build()
+        if projection is not None:
+            t = t.select(projection)
+        return t
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def read_partition(self, index: int, projection=None, filters=None):
+        return self.read(projection=projection, filters=filters)
+
+    def estimated_bytes(self) -> int:
+        # tiny by construction; a concrete size keeps the host-route sizing
+        # path working when the default backend is an accelerator
+        return 1 << 16
+
+
+class MetricsTable(_SystemTable):
+    """`system.metrics`: one row per counter, four per histogram
+    (count/sum/min/max), straight out of the process registry."""
+
+    _arrow_schema = pa.schema([
+        pa.field("name", pa.string(), False),
+        pa.field("kind", pa.string(), False),
+        pa.field("value", pa.float64(), False),
+    ])
+
+    def _build(self) -> pa.Table:
+        names: list = []
+        kinds: list = []
+        values: list = []
+        for name, v in sorted(tracing.counters().items()):
+            names.append(name)
+            kinds.append("counter")
+            values.append(float(v))
+        for name, h in sorted(tracing.histograms().items()):
+            for part in ("count", "sum", "min", "max"):
+                names.append(name)
+                kinds.append(f"hist_{part}")
+                values.append(float(h[part]))
+        return pa.Table.from_arrays(
+            [pa.array(names, type=pa.string()),
+             pa.array(kinds, type=pa.string()),
+             pa.array(values, type=pa.float64())],
+            schema=self._arrow_schema)
+
+
+class QueryLogTable(_SystemTable):
+    """`system.query_log`: the ring of recent per-query stats (most recent
+    last). rows = -1 marks a query whose row count was never observed."""
+
+    _arrow_schema = pa.schema([
+        pa.field("qid", pa.int64(), False),
+        pa.field("ts", pa.float64(), False),
+        pa.field("sql", pa.string(), False),
+        pa.field("tier", pa.string(), False),
+        pa.field("rows", pa.int64(), False),
+        pa.field("elapsed_s", pa.float64(), False),
+        pa.field("compile_s", pa.float64(), False),
+        pa.field("execute_s", pa.float64(), False),
+        pa.field("h2d_bytes", pa.int64(), False),
+        pa.field("d2h_bytes", pa.int64(), False),
+        pa.field("operators", pa.int64(), False),
+        pa.field("grace_partitions", pa.int64(), False),
+        pa.field("jit_misses", pa.int64(), False),
+        pa.field("cache_hits", pa.int64(), False),
+    ])
+
+    def _build(self) -> pa.Table:
+        recs = [qs.to_record() for qs in stats.query_log()]
+        cols = {f.name: [r[f.name] for r in recs]
+                for f in self._arrow_schema}
+        return pa.Table.from_arrays(
+            [pa.array(cols[f.name], type=f.type) for f in self._arrow_schema],
+            schema=self._arrow_schema)
+
+
+def register_system_tables(catalog) -> None:
+    """Install the system namespace into a catalog (engine construction)."""
+    catalog.register_system("system.metrics", MetricsTable())
+    catalog.register_system("system.query_log", QueryLogTable())

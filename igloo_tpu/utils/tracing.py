@@ -1,43 +1,262 @@
-"""Tracing / timing spans + process-wide counters.
+"""Tracing / timing spans + the process metrics registry.
 
-The reference only has `tracing` calls in its cache crate with no subscriber ever
-installed (SURVEY.md §5.1); here spans are real: nested timers recorded into a
-thread-local trace that callers (CLI --explain-timing, coordinator per-fragment
-metrics, bench harness) can read. Counters track cross-query events (compile
-cache hits/misses, batch cache hits/evictions). `profile_trace()` wraps
-`jax.profiler.trace` for device-level profiles.
+The reference only has `tracing` calls in its cache crate with no subscriber
+ever installed (SURVEY.md §5.1); here the layer is real and has three parts:
+
+- spans: nested timers recorded into a thread-local trace that callers (CLI
+  --timing, bench harness) can read. `roots()` is bounded (ROOTS_MAX) so
+  long-lived processes — the coordinator in particular — don't leak spans.
+- MetricsRegistry: process-wide counters AND histograms (query latency,
+  compile time, transfer bytes, rows). Counters stay CUMULATIVE; per-query
+  numbers come from `counter_delta()`, a thread-isolated snapshot-diff
+  context manager, so concurrent queries can never pollute each other's
+  deltas. `prometheus_text()` renders the registry for the cluster's
+  `metrics` Flight action.
+- `profile_trace()` wraps `jax.profiler.trace` for device-level profiles.
+
+Every counter/histogram name used in the codebase is cataloged in
+docs/observability.md; scripts/check_metrics_names.py fails the verify flow
+when the two drift.
 """
 from __future__ import annotations
 
 import contextlib
 import logging
+import re
 import threading
 import time
-from collections import Counter
+from collections import Counter, deque
 from dataclasses import dataclass, field
+from typing import Optional
 
 log = logging.getLogger("igloo_tpu")
 
 _tls = threading.local()
 
-_counters: Counter = Counter()
-_counters_lock = threading.Lock()
+# spans kept per thread: enough for tooling that reads a few recent queries,
+# bounded so a server thread answering queries for days cannot grow without
+# limit (the coordinator used to leak its whole query history here)
+ROOTS_MAX = 64
+
+
+@dataclass
+class HistogramData:
+    """Streaming summary of one histogram: count/sum/min/max (no buckets —
+    the consumers are per-query deltas and Prometheus summaries, neither of
+    which needs quantiles badly enough to pay per-observation bucketing)."""
+    count: int = 0
+    sum: float = 0.0
+    min: float = float("inf")
+    max: float = float("-inf")
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def as_dict(self) -> dict:
+        if not self.count:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0}
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max}
+
+
+class MetricsRegistry:
+    """Thread-safe process metrics: monotonic counters + summary histograms.
+
+    `version()` is a mutation counter — the system.metrics table provider
+    uses it as its snapshot token, so the engine's caches invalidate exactly
+    when telemetry changed."""
+
+    def __init__(self):
+        self._counters: Counter = Counter()
+        self._hists: dict[str, HistogramData] = {}
+        self._lock = threading.Lock()
+        self._version = 0
+
+    def counter(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += delta
+            self._version += 1
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = HistogramData()
+            h.observe(value)
+            self._version += 1
+
+    def counters(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+    def histograms(self) -> dict:
+        with self._lock:
+            return {k: h.as_dict() for k, h in self._hists.items()}
+
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def bump_version(self) -> None:
+        """External telemetry sources (the query log ring) share the
+        registry's snapshot token by bumping it on their own mutations."""
+        with self._lock:
+            self._version += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._hists.clear()
+            self._version += 1
+
+
+REGISTRY = MetricsRegistry()
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def prometheus_text(prefix: str = "igloo", extra_lines: Optional[list] = None
+                    ) -> str:
+    """Render the registry in the Prometheus text exposition format.
+    Counters become `<prefix>_<name>_total`; histograms a summary-style
+    `_count`/`_sum` pair plus `_min`/`_max` gauges. `extra_lines` (already
+    formatted) are appended — the coordinator adds its per-worker fragment
+    aggregates there."""
+    lines: list[str] = []
+    for name, value in sorted(REGISTRY.counters().items()):
+        m = f"{prefix}_{_sanitize(name)}_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {value}")
+    for name, h in sorted(REGISTRY.histograms().items()):
+        m = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# TYPE {m} summary")
+        lines.append(f"{m}_count {h['count']}")
+        lines.append(f"{m}_sum {h['sum']}")
+        lines.append(f"{m}_min {h['min']}")
+        lines.append(f"{m}_max {h['max']}")
+    if extra_lines:
+        lines.extend(extra_lines)
+    return "\n".join(lines) + "\n"
+
+
+# --- counters (module-level API, backed by REGISTRY) ------------------------
+
+
+# guards collector Counters: a collector is thread-local by default, but
+# `adopt_collectors` shares it with a worker thread (the GRACE prefetch
+# thread), and `c[name] += d` is a non-atomic read-modify-write
+_delta_lock = threading.Lock()
 
 
 def counter(name: str, delta: int = 1) -> None:
-    """Bump a process-wide counter (thread-safe)."""
-    with _counters_lock:
-        _counters[name] += delta
+    """Bump a process-wide counter (thread-safe). Any `counter_delta()`
+    collectors active on the CURRENT thread accumulate the same bump, which
+    is what keeps per-query deltas isolated across concurrent queries."""
+    REGISTRY.counter(name, delta)
+    cols = getattr(_tls, "collectors", None)
+    if cols:
+        with _delta_lock:
+            for c in cols:
+                c[name] += delta
+
+
+def histogram(name: str, value: float) -> None:
+    """Record one observation into a process-wide histogram."""
+    REGISTRY.observe(name, value)
 
 
 def counters() -> dict:
-    with _counters_lock:
-        return dict(_counters)
+    return REGISTRY.counters()
+
+
+def histograms() -> dict:
+    return REGISTRY.histograms()
 
 
 def reset_counters() -> None:
-    with _counters_lock:
-        _counters.clear()
+    REGISTRY.reset()
+
+
+class CounterDelta:
+    """Live view of the counter bumps made on this thread (plus any adopted
+    threads) since the enclosing `counter_delta()` opened. Readable both
+    inside and after the `with` block."""
+
+    def __init__(self, data: Counter):
+        self._data = data
+
+    def get(self, name: str, default: int = 0) -> int:
+        with _delta_lock:
+            return self._data.get(name, default)
+
+    def values(self) -> dict:
+        with _delta_lock:
+            return {k: v for k, v in self._data.items() if v}
+
+    def __getitem__(self, name: str) -> int:
+        return self._data[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._data
+
+
+@contextlib.contextmanager
+def counter_delta():
+    """Per-query counter deltas as a first-class API.
+
+    Yields a CounterDelta that accumulates every `counter()` bump made on the
+    current thread while the block is open — NOT a snapshot-diff of the
+    process-wide totals, so two threads each inside their own
+    `counter_delta()` observe only their own increments. Worker threads an
+    operation fans out to (the GRACE prefetch thread) join via
+    `adopt_collectors(capture_collectors())`.
+    """
+    c: Counter = Counter()
+    cols = getattr(_tls, "collectors", None)
+    if cols is None:
+        cols = _tls.collectors = []
+    cols.append(c)
+    try:
+        yield CounterDelta(c)
+    finally:
+        _remove_by_identity(cols, c)
+
+
+def _remove_by_identity(cols: list, c) -> None:
+    # Counter compares by CONTENT — list.remove would pop a different,
+    # equal-content collector (two empty deltas are ==); remove by identity
+    for i, x in enumerate(cols):
+        if x is c:
+            del cols[i]
+            return
+
+
+def capture_collectors() -> tuple:
+    """Snapshot of the current thread's active delta collectors, for handing
+    to a worker thread that does work on this query's behalf."""
+    return tuple(getattr(_tls, "collectors", ()))
+
+
+@contextlib.contextmanager
+def adopt_collectors(cols: tuple):
+    """Run a block on a worker thread with a parent thread's collectors
+    installed, so its counter bumps land in the parent's deltas too."""
+    own = getattr(_tls, "collectors", None)
+    if own is None:
+        own = _tls.collectors = []
+    own.extend(cols)
+    try:
+        yield
+    finally:
+        for c in cols:
+            _remove_by_identity(own, c)
 
 
 @contextlib.contextmanager
@@ -69,25 +288,22 @@ class Span:
 def _stack() -> list:
     if not hasattr(_tls, "stack"):
         _tls.stack = []
-        _tls.roots = []
+        _tls.roots = deque(maxlen=ROOTS_MAX)
     return _tls.stack
 
 
-def roots() -> list:
+def roots() -> deque:
     _stack()
     return _tls.roots
 
 
 def reset(counters_too: bool = False) -> None:
-    """Clear the thread-local span trace. Counters are PROCESS-WIDE and
-    CUMULATIVE and are NOT cleared by default — per-query deltas must be
-    snapshot-diffed (c0 = counters(); ...; diff against c0), or pass
-    counters_too=True in single-threaded tooling that owns the whole process
-    (clearing them from one thread would corrupt other in-flight queries'
-    metrics). Misreading cumulative counters as per-query deltas once cost an
-    hour of phantom cache-bug hunting; hence this warning."""
+    """Clear the thread-local span trace. Counters are process-wide and
+    cumulative; per-query numbers come from `counter_delta()`, which cannot
+    be polluted by concurrent queries. Pass counters_too=True only in
+    single-threaded tooling that owns the whole process."""
     _tls.stack = []
-    _tls.roots = []
+    _tls.roots = deque(maxlen=ROOTS_MAX)
     if counters_too:
         reset_counters()
 
@@ -106,6 +322,7 @@ def span(name: str):
         log.debug("span %s took %.3fms", name, s.elapsed_s * 1e3)
 
 
-def last_trace() -> str:
-    r = roots()
-    return "\n".join(s.tree() for s in r[-2:])
+def last_trace(n: int = 2) -> str:
+    """Render the `n` most recent root spans of this thread's trace."""
+    r = list(roots())
+    return "\n".join(s.tree() for s in r[-n:])

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Metric-name drift lint (run by scripts/validate.sh).
+
+Cross-checks every `tracing.counter(...)` / `tracing.histogram(...)` name
+used in igloo_tpu/ against the catalog in docs/observability.md, so metric
+names cannot silently drift or typo-fork (`pack.hits` vs `pack.hit`).
+
+Rules:
+- a literal name must be covered by the catalog verbatim (or by a
+  documented `prefix.*` wildcard);
+- an f-string name is reduced to its literal prefix (up to the first `{`,
+  trailing dot stripped) which must be covered by a `prefix.*` wildcard;
+- a name with NO literal prefix (e.g. `f"{self.counter_prefix}.hit"`) must
+  resolve through DYNAMIC_PREFIXES below, each expansion documented.
+
+Exit 1 with a report on any violation; catalog entries no code uses are
+warnings only (some call sites are platform-gated).
+"""
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+DOC = ROOT / "docs" / "observability.md"
+SRC = ROOT / "igloo_tpu"
+
+# placeholder -> the values it takes across the codebase (SnapshotLRU
+# subclasses set counter_prefix)
+DYNAMIC_PREFIXES = {
+    "self.counter_prefix": ["cache", "result_cache"],
+}
+
+CALL_RE = re.compile(
+    r"(?:tracing\.)?(?:counter|histogram)\(\s*(f?)[\"']", re.MULTILINE)
+# metric-name string literals inside one call region (covers ternary arms:
+# counter("a" if ok else "b"))
+NAME_STR_RE = re.compile(
+    r"[\"']([a-z][a-z0-9_]*(?:\.[a-z0-9_{}.]+)*|\{[a-zA-Z_.]+\}[a-z0-9_.]*)"
+    r"[\"']")
+DOC_NAME_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_*.]+)+)`")
+
+
+def call_sites() -> list:
+    """-> [(name, is_fstring, 'file:line')] for every metric call site."""
+    out = []
+    for path in sorted(SRC.rglob("*.py")):
+        text = path.read_text()
+        for m in CALL_RE.finditer(text):
+            line = text[: m.start()].count("\n") + 1
+            region = text[m.start():]
+            # the call's argument region: up to the first close-paren at
+            # line end (good enough for this codebase's formatting)
+            end = region.find(")\n")
+            region = region[: end + 1 if end >= 0 else 240]
+            is_f = m.group(1) == "f" or ', f"' in region or " f\"" in region
+            where = f"{path.relative_to(ROOT)}:{line}"
+            for name in NAME_STR_RE.findall(region):
+                if "." not in name and "{" not in name:
+                    continue  # not a metric-shaped string (e.g. format arg)
+                out.append((name, is_f or "{" in name, where))
+    return out
+
+
+def doc_names() -> set:
+    """Backticked metric names inside the '## Metrics catalog' section."""
+    text = DOC.read_text()
+    start = text.find("## Metrics catalog")
+    end = text.find("## Per-query", start)
+    section = text[start:end] if start >= 0 else text
+    return set(DOC_NAME_RE.findall(section))
+
+
+def covered(name: str, catalog: set) -> bool:
+    if name in catalog:
+        return True
+    parts = name.split(".")
+    return any(".".join(parts[:i]) + ".*" in catalog
+               for i in range(len(parts) - 1, 0, -1))
+
+
+def main() -> int:
+    if not DOC.exists():
+        print(f"check_metrics_names: missing {DOC}", file=sys.stderr)
+        return 1
+    catalog = doc_names()
+    errors = []
+    used_plain: set = set()
+
+    for name, is_f, where in call_sites():
+        if not is_f:
+            used_plain.add(name)
+            if not covered(name, catalog):
+                errors.append(f"{name}: used at {where} but not documented "
+                              "in docs/observability.md")
+            continue
+        if name.startswith("{"):
+            ph = name[1:].split("}", 1)[0]
+            suffix = name.split("}", 1)[1].lstrip(".") if "}" in name else ""
+            expansions = DYNAMIC_PREFIXES.get(ph)
+            if expansions is None:
+                errors.append(f"{name}: fully dynamic metric name at "
+                              f"{where} not in DYNAMIC_PREFIXES")
+                continue
+            for p in expansions:
+                full = f"{p}.{suffix}" if suffix else p
+                used_plain.add(full)
+                if not covered(full, catalog):
+                    errors.append(f"{full}: undocumented (dynamic-prefix "
+                                  f"call at {where})")
+            continue
+        prefix = name.split("{", 1)[0].rstrip(".")
+        used_plain.add(prefix + ".dynamic")
+        if not covered(prefix + ".dynamic", catalog):
+            errors.append(f"{name}: f-string at {where} needs a "
+                          f"`{prefix}.*` wildcard in the catalog")
+
+    for entry in sorted(catalog):
+        base = entry[:-2] if entry.endswith(".*") else entry
+        hit = any(u == base or u.startswith(base + ".")
+                  for u in used_plain) if entry.endswith(".*") \
+            else base in used_plain
+        if not hit:
+            print(f"warning: catalog entry `{entry}` matches no code call "
+                  f"site", file=sys.stderr)
+
+    if errors:
+        print("check_metrics_names: FAIL", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print(f"check_metrics_names: OK ({len(used_plain)} names, "
+          f"{len(catalog)} catalog entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

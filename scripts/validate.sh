@@ -5,6 +5,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== metric-name catalog lint =="
+python scripts/check_metrics_names.py
+
 echo "== ruff (lint) =="
 if python -c "import ruff" 2>/dev/null || command -v ruff >/dev/null; then
   python -m ruff check igloo_tpu tests bench.py __graft_entry__.py

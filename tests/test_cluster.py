@@ -165,7 +165,32 @@ def test_query_metrics_surface(cluster):
     assert len(m["fragments"]) >= 2  # partials + merge
     for f in m["fragments"]:
         assert f["rows"] >= 0 and f["elapsed_s"] >= 0 and f["worker"]
+        # ISSUE 3: per-fragment time attribution + transfer/compile deltas
+        assert "dispatch_s" in f and f["dispatch_s"] >= 0
+        assert "dep_fetch_s" in f and "jit_misses" in f
+    assert "fetch_s" in m and "recover_s" in m
     client.close()
+
+
+def test_metrics_flight_action(cluster):
+    """Both servers serve Prometheus text via the `metrics` action; the
+    coordinator's includes worker-aggregated fragment stats."""
+    from igloo_tpu.cluster.rpc import flight_action_raw
+    client = DistributedClient(cluster["addr"])
+    client.execute("SELECT o_status, COUNT(*) AS c FROM orders "
+                   "GROUP BY o_status ORDER BY o_status")
+    client.close()
+    text = flight_action_raw(cluster["addr"], "metrics").decode()
+    assert "igloo_workers_live 2" in text
+    assert "# TYPE igloo_coordinator_worker_fragments_total counter" in text
+    assert 'igloo_coordinator_worker_fragments_total{worker="' in text
+    assert 'igloo_coordinator_worker_fragment_rows_total{worker="' in text
+    assert "igloo_coordinator_distributed_queries_total" in text
+    # worker-side registry, scraped directly from a worker
+    waddr = cluster["workers"][0].address
+    wtext = flight_action_raw(waddr, "metrics").decode()
+    assert "igloo_worker_fragments_total" in wtext
+    assert "igloo_jit_miss_total" in wtext
 
 
 def test_client_schema_without_execution(cluster):
