@@ -3,12 +3,18 @@
 
 Run: `python bench.py` (the round driver captures stdout).
 
-Prints per-query detail lines to stderr and EXACTLY ONE JSON line to stdout:
+Prints per-query detail lines to stderr and EXACTLY ONE compact JSON line to
+stdout:
 
     {"metric": "tpch_warm_rows_per_s", "value": N, "unit": "rows/s/chip",
-     "vs_baseline": R, "detail": {...}}
+     "vs_baseline": R}
 
-where `value` is the geometric-mean warm throughput over the TPC-H queries
+The multi-KB per-query detail blob goes to BENCH_DETAIL.json next to this
+script instead of riding the stdout line — the round driver's capture
+truncates long lines, which left two rounds of BENCH_*.json artifacts with
+"parsed": null. The stdout line must stay small enough to always parse.
+
+`value` is the geometric-mean warm throughput over the TPC-H queries
 (rows of lineitem / MEDIAN warm wall-clock) on the default JAX device (one TPU
 chip under the driver), and `vs_baseline` is the ratio of that throughput to
 single-threaded pandas executing the same queries over the same data (>1.0 =
@@ -236,7 +242,8 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
             "grace": rec.get("grace", False),
             "rows_per_s": round(rps)}
         for k in ("grace_partitions", "grace_pipeline", "counters",
-                  "warm_h2d_bytes", "peak_hbm_bytes"):
+                  "warm_h2d_bytes", "peak_hbm_bytes", "shuffle_buckets",
+                  "exchange_bytes"):
             if k in rec:
                 block["queries"][q][k] = rec[k]
         log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s "
@@ -311,12 +318,20 @@ def main() -> None:
         return math.exp(sum(math.log(x) for x in xs) / len(xs)) if xs else 0.0
     gmean_ours, gmean_base = gmean(ours_tp), gmean(base_tp)
     detail["elapsed_s"] = round(time.time() - T_START, 1)
+    # detail is a multi-KB blob: write it to a sidecar file, keep stdout to
+    # ONE short driver-parseable line (see module docstring)
+    detail_path = os.path.join(REPO, "BENCH_DETAIL.json")
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(detail, f, indent=1, sort_keys=True)
+        log(f"bench: per-query detail written to {detail_path}")
+    except OSError as e:
+        log(f"bench: could not write {detail_path}: {e}")
     result = {
         "metric": "tpch_warm_rows_per_s",
         "value": round(gmean_ours),
         "unit": "rows/s/chip",
         "vs_baseline": round(gmean_ours / gmean_base, 4) if gmean_base else 0.0,
-        "detail": detail,
     }
     print(json.dumps(result), flush=True)
 

@@ -47,11 +47,13 @@ _CONVERGENCE_COUNTERS = ("jit.miss", "fused.compact_repair",
 _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 
 # per-query counter-delta prefixes recorded into the sweep JSON (cold run):
-# compile cache, packed-key planners, out-of-core tiers, transfer bytes —
-# the trajectory data that lets a BENCH_*.json regression be EXPLAINED
-# (route flip? cache miss? partition-count change?), not just detected
+# compile cache, packed-key planners, out-of-core tiers, transfer bytes,
+# cross-worker exchange — the trajectory data that lets a BENCH_*.json
+# regression be EXPLAINED (route flip? cache miss? partition-count change?),
+# not just detected
 _DELTA_PREFIXES = ("jit.", "pack.", "grace.", "chunked.", "xfer.",
-                   "cache.", "result_cache.", "engine.", "fused.", "join.")
+                   "cache.", "result_cache.", "engine.", "fused.", "join.",
+                   "exchange.")
 
 
 def _peak_hbm_bytes() -> int:
@@ -113,6 +115,13 @@ def run_query(engine, sql: str, trials: int) -> dict:
            "warm_h2d_bytes": warm_delta.get("xfer.h2d_bytes") //
            max(trials, 1),
            "peak_hbm_bytes": _peak_hbm_bytes()}
+    # fragment-tier shuffle adoption (0 on a single-node sweep; populated
+    # when the engine under test routes through the distributed exchange):
+    # bucket partition ops and bytes moved worker<->worker per query, so the
+    # perf trajectory captures the shuffle tier once bench gains a
+    # distributed mode
+    rec["shuffle_buckets"] = query_delta.get("exchange.partitions")
+    rec["exchange_bytes"] = query_delta.get("exchange.fetch_bytes")
     joins = query_delta.get("grace.join")
     rec["grace"] = query_delta.get("engine.grace_route") > 0
     if rec["grace"]:

@@ -28,6 +28,7 @@ import json
 import threading
 import time
 import uuid
+import weakref
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -36,7 +37,7 @@ import pyarrow.flight as flight
 
 from igloo_tpu.cluster import rpc, serde
 from igloo_tpu.cluster.fragment import DistributedPlanner, QueryFragment
-from igloo_tpu.cluster.rpc import flight_action, flight_get_table
+from igloo_tpu.cluster.rpc import flight_action
 from igloo_tpu.engine import QueryEngine
 from igloo_tpu.errors import IglooError
 from igloo_tpu.utils import tracing
@@ -127,6 +128,16 @@ class DistributedExecutor:
         self._totals_lock = threading.Lock()
 
     def execute(self, fragments: list[QueryFragment]) -> pa.Table:
+        schema, gen = self.execute_stream(fragments)
+        return pa.Table.from_batches(list(gen), schema=schema)
+
+    def execute_stream(self, fragments: list[QueryFragment]
+                       ) -> tuple[pa.Schema, object]:
+        """Run the fragment waves, then return (schema, batch generator)
+        streaming the root result from its worker — the coordinator never
+        holds more than one in-flight batch of a distributed result. The
+        generator publishes per-query metrics and releases worker-held
+        fragment results when it is exhausted (or closed)."""
         frags = {f.id: f for f in fragments}
         root_id = fragments[-1].id
         completed: dict[str, str] = {}  # frag id -> worker addr holding result
@@ -140,6 +151,9 @@ class DistributedExecutor:
         # query-level recover_s/fetch_s cover re-dispatch and the root fetch.
         metrics: dict = {"fragments": [], "recoveries": 0,
                          "recover_s": 0.0, "fetch_s": 0.0}
+        shuffle_buckets = {f.bucket for f in fragments
+                          if f.bucket is not None}
+        metrics["shuffle_buckets"] = len(shuffle_buckets)
         try:
             with cf.ThreadPoolExecutor(self.max_parallel) as pool:
                 while pending:
@@ -177,8 +191,40 @@ class DistributedExecutor:
                         t_rec = time.perf_counter()
                         self._recover(dead, frags, completed, pending)
                         metrics["recover_s"] += time.perf_counter() - t_rec
-                t_fetch = time.perf_counter()
-                table = self._fetch(completed[root_id], root_id)
+            # open the root stream eagerly: the schema the worker reports is
+            # authoritative, and a root holder lost between the last wave and
+            # here surfaces now, while the caller can still see the error
+            t_fetch = time.perf_counter()
+            schema, batch_iter = rpc.flight_stream_batches(
+                completed[root_id], root_id)
+        except BaseException:
+            self._release(frags, completed, list(frags))
+            raise
+
+        done = [False]
+
+        def cleanup():
+            # idempotent: runs from the generator's finally on the normal
+            # path, or from the weakref finalizer when a client abandons the
+            # stream before pulling the first batch (a never-started
+            # generator's close() does not enter its try/finally)
+            if done[0]:
+                return
+            done[0] = True
+            close = getattr(batch_iter, "close", None)
+            if close is not None:
+                try:
+                    close()  # drop the root worker's Flight connection
+                except Exception:
+                    pass
+            self._release(frags, completed, list(frags))
+
+        def gen():
+            total_rows = 0
+            try:
+                for batch in batch_iter:
+                    total_rows += batch.num_rows
+                    yield batch
                 metrics["fetch_s"] = round(time.perf_counter() - t_fetch, 6)
                 # dedupe by fragment id (a fragment re-run after a worker
                 # death appends twice; last execution wins)
@@ -187,14 +233,18 @@ class DistributedExecutor:
                     by_id[info.get("id", len(by_id))] = info
                 metrics["fragments"] = list(by_id.values())
                 metrics.update(
-                    total_rows=table.num_rows, recoveries=recoveries,
+                    total_rows=total_rows, recoveries=recoveries,
                     recover_s=round(metrics["recover_s"], 6),
+                    exchange_bytes=sum(i.get("exchange_bytes") or 0
+                                       for i in metrics["fragments"]),
                     execution_time_s=round(time.time() - t_start, 6))
                 self.last_metrics = metrics  # atomic publish
                 self._accumulate(metrics)
-                return table
-        finally:
-            self._release(frags, completed, list(frags))
+            finally:
+                cleanup()
+        g = gen()
+        weakref.finalize(g, cleanup)
+        return schema, g
 
     # --- internals ---
 
@@ -210,6 +260,10 @@ class DistributedExecutor:
             info = flight_action(f.worker, "execute_fragment", req)
             wall = time.perf_counter() - t0
             info["addr"] = f.worker
+            if f.kind:
+                info["kind"] = f.kind
+            if f.bucket is not None:
+                info["bucket"] = f.bucket
             # dispatch = RPC wall minus what the worker accounted for
             # (execution + dependency fetches): serialization + network +
             # the worker's action-handler queue
@@ -250,9 +304,6 @@ class DistributedExecutor:
                 frags[fid].worker = next(rr)
                 tracing.counter("coordinator.fragments_redispatched")
 
-    def _fetch(self, addr: str, frag_id: str) -> pa.Table:
-        return flight_get_table(addr, frag_id)
-
     def _accumulate(self, metrics: dict) -> None:
         """Fold one query's per-fragment stats into the cumulative per-worker
         totals served by the coordinator `metrics` action."""
@@ -262,7 +313,8 @@ class DistributedExecutor:
                     info.get("worker", info.get("addr", "?")),
                     {"fragments": 0, "rows": 0, "execute_s": 0.0,
                      "dispatch_s": 0.0, "dep_fetch_s": 0.0,
-                     "h2d_bytes": 0, "d2h_bytes": 0, "jit_misses": 0})
+                     "h2d_bytes": 0, "d2h_bytes": 0, "jit_misses": 0,
+                     "exchange_bytes": 0})
                 t["fragments"] += 1
                 t["rows"] += info.get("rows", 0)
                 t["execute_s"] += info.get("elapsed_s", 0.0)
@@ -271,6 +323,7 @@ class DistributedExecutor:
                 t["h2d_bytes"] += info.get("h2d_bytes", 0) or 0
                 t["d2h_bytes"] += info.get("d2h_bytes", 0) or 0
                 t["jit_misses"] += info.get("jit_misses", 0) or 0
+                t["exchange_bytes"] += info.get("exchange_bytes", 0) or 0
 
     def prometheus_lines(self) -> list:
         """Worker-aggregated fragment stats as labeled Prometheus lines."""
@@ -292,11 +345,13 @@ class DistributedExecutor:
                 ("igloo_coordinator_worker_fragment_d2h_bytes_total", "d2h_bytes",
                  "counter"),
                 ("igloo_coordinator_worker_fragment_jit_misses_total", "jit_misses",
-                 "counter")):
+                 "counter"),
+                ("igloo_coordinator_worker_exchange_bytes_total",
+                 "exchange_bytes", "counter")):
             if totals:
                 lines.append(f"# TYPE {name} {kind}")
             for w, t in sorted(totals.items()):
-                lines.append(f'{name}{{worker="{w}"}} {t[key]}')
+                lines.append(f'{name}{{worker="{w}"}} {t.get(key, 0)}')
         return lines
 
     def _release(self, frags: dict[str, QueryFragment],
@@ -389,7 +444,10 @@ class CoordinatorServer(flight.FlightServerBase):
 
     # --- query execution ---
 
-    def execute_sql(self, sql: str) -> pa.Table:
+    def execute_sql(self, sql: str, stream: bool = False):
+        """-> pa.Table, or — for `stream=True` on the distributed path —
+        (pa.Schema, record-batch generator) so do_get can relay the root
+        worker's stream batch-wise instead of materializing it here."""
         live = self.membership.live()
         if not live:
             # a coordinator with no workers is still a working single-node
@@ -418,6 +476,8 @@ class CoordinatorServer(flight.FlightServerBase):
         planner = DistributedPlanner([w.addr for w in live])
         frags = planner.plan(plan)
         tracing.counter("coordinator.distributed_queries")
+        if stream:
+            return self.executor.execute_stream(frags)
         return self.executor.execute(frags)
 
     def _distributable(self, plan) -> bool:
@@ -512,10 +572,13 @@ class CoordinatorServer(flight.FlightServerBase):
     def do_get(self, context, ticket):
         sql = ticket.ticket.decode()
         try:
-            table = self.execute_sql(sql)
+            out = self.execute_sql(sql, stream=True)
         except IglooError as ex:
             raise flight.FlightServerError(str(ex))
-        return flight.RecordBatchStream(table)
+        if isinstance(out, tuple):
+            # distributed: relay the root worker's stream batch-wise
+            return flight.GeneratorStream(*out)
+        return flight.RecordBatchStream(out)
 
     def do_put(self, context, descriptor, reader, writer):
         name = self._descriptor_table(descriptor)

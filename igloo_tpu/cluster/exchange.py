@@ -1,0 +1,324 @@
+"""Cross-worker shuffle exchange: hash partitioning + the bucketed,
+bytes-budgeted fragment store.
+
+The reference declares `FragmentType::Shuffle` (crates/coordinator/src/
+fragment.rs:12) and never constructs it; its worker shuffle fetch returns
+empty bytes (crates/worker/src/service.rs:26-32). This module is the real
+thing for the Flight/fragment tier (the TPU mesh tier has its own all_to_all
+shuffle in parallel/shuffle.py — see docs/distributed.md):
+
+- `bucket_ids` assigns every row of an Arrow table to one of N buckets by a
+  deterministic hash of its join-key columns. The hash is a pure function of
+  the key BYTES (strings go through the native hash64.c dictionary path, the
+  same primitive GRACE partitioning uses), so two workers hashing the two
+  sides of a join agree on bucket placement without coordination.
+- `FragmentStore` replaces the worker's `dict[str, pa.Table]` result map: a
+  fragment result is held as a list of record batches with optional per-bucket
+  partition metadata (rows/bytes per bucket), under a configurable bytes
+  budget. Results that push the store over budget spill to Arrow IPC files
+  and are served batch-at-a-time off disk — a multi-GB fragment
+  result never needs to be resident to be transferred.
+- do_get tickets address either a whole fragment (`<frag_id>`) or one bucket
+  slice (JSON `{"frag": id, "bucket": b, "nbuckets": n}`) — the wire format
+  of the per-bucket exchange the distributed planner emits for joins.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from igloo_tpu.utils import tracing
+
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_MIX = np.uint64(0xC2B2AE3D27D4EB4F)
+
+# stream granularity: small enough that one in-flight batch is cheap to
+# buffer on both ends, large enough that per-message overhead amortizes
+BATCH_ROWS = 65536
+
+STORE_BUDGET_ENV = "IGLOO_FRAGMENT_STORE_BYTES"
+DEFAULT_STORE_BUDGET = 1 << 30
+
+
+# --- deterministic key hashing ----------------------------------------------
+
+
+def _hash_column(col, typ) -> np.ndarray:
+    """uint64 hash lane for one key column (process-independent: strings hash
+    their bytes via native hash64.c, numerics hash a canonical int64/bit
+    pattern). Nulls hash as 0 — they only need a consistent ROUTE, equality
+    semantics stay with the join that consumes the bucket."""
+    import pyarrow.compute as pc
+
+    from igloo_tpu.exec.batch import hash64_bytes
+    if pa.types.is_dictionary(typ) or pa.types.is_string(typ) or \
+            pa.types.is_large_string(typ):
+        if not pa.types.is_dictionary(col.type):
+            col = col.dictionary_encode()
+        dvals = np.asarray(col.dictionary.to_numpy(zero_copy_only=False),
+                           dtype=object)
+        ids = np.asarray(pc.fill_null(col.indices, 0)).astype(np.int64)
+        vals = hash64_bytes(dvals, seed=0)[ids] if len(dvals) else \
+            np.zeros(len(col), dtype=np.uint64)
+    elif pa.types.is_floating(typ):
+        v = np.asarray(col.cast(pa.float64()).fill_null(0.0),
+                       dtype=np.float64)
+        # canonicalize -0.0 -> +0.0 and NaN -> one bit pattern so equal keys
+        # (SQL equality) always share a bucket
+        v = v + 0.0
+        v = np.where(np.isnan(v), np.float64(0.0), v)
+        vals = v.view(np.uint64)
+    else:
+        if pa.types.is_date32(typ):
+            col = col.cast(pa.int32())
+        vals = np.asarray(col.cast(pa.int64()).fill_null(0)).astype(np.uint64)
+    h = vals * _GOLDEN
+    return h ^ (h >> np.uint64(29))
+
+
+def key_hash(table: pa.Table, key_indices: list[int]) -> np.ndarray:
+    """Combined uint64 hash of the key columns named by position."""
+    h = np.full(table.num_rows, np.uint64(0x243F6A8885A308D3),
+                dtype=np.uint64)
+    for i in key_indices:
+        col = table.column(i)
+        col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        c = _hash_column(col, table.schema.field(i).type)
+        h = (h ^ c) * _MIX
+        h ^= h >> np.uint64(33)
+    return h
+
+
+def bucket_ids(table: pa.Table, key_indices: list[int],
+               nbuckets: int) -> np.ndarray:
+    """int64 bucket id per row (high-bits mix so the modulus is independent
+    of the low bits local join sorts use)."""
+    h = key_hash(table, key_indices)
+    return ((h >> np.uint64(17)) % np.uint64(nbuckets)).astype(np.int64)
+
+
+def partition_table(table: pa.Table, key_indices: list[int],
+                    nbuckets: int) -> list[pa.Table]:
+    """Split `table` into `nbuckets` bucket slices by key hash: ONE stable
+    argsort + boundary slices (zero-copy views of the reordered table),
+    the same shape as GRACE's `_split_by_hash`."""
+    if table.num_rows == 0:
+        return [table.slice(0, 0) for _ in range(nbuckets)]
+    pid = bucket_ids(table, key_indices, nbuckets)
+    order = np.argsort(pid, kind="stable")
+    sorted_tbl = table.take(order)
+    counts = np.bincount(pid, minlength=nbuckets)
+    out, off = [], 0
+    for b in range(nbuckets):
+        c = int(counts[b])
+        out.append(sorted_tbl.slice(off, c))
+        off += c
+    return out
+
+
+# --- do_get ticket codec -----------------------------------------------------
+
+
+def make_ticket(frag_id: str, bucket: Optional[int] = None,
+                nbuckets: Optional[int] = None) -> bytes:
+    if bucket is None:
+        return frag_id.encode()
+    return json.dumps({"frag": frag_id, "bucket": bucket,
+                       "nbuckets": nbuckets}).encode()
+
+
+def parse_ticket(raw: bytes) -> tuple[str, Optional[int], Optional[int]]:
+    if raw.startswith(b"{"):
+        d = json.loads(raw.decode())
+        return d["frag"], d.get("bucket"), d.get("nbuckets")
+    return raw.decode(), None, None
+
+
+# --- the bytes-budgeted fragment store --------------------------------------
+
+
+@dataclass
+class _Stored:
+    schema: pa.Schema
+    batches: Optional[list]            # list[pa.RecordBatch]; None = spilled
+    nbytes: int
+    nbuckets: Optional[int] = None     # hash-partition bucket count
+    ranges: Optional[list] = None      # per-bucket (start, count) batch ranges
+    meta: Optional[list] = None        # per-bucket {"rows": .., "bytes": ..}
+    spill_path: Optional[str] = None
+    seq: int = 0                       # insertion order (spill oldest first)
+    rows: int = 0
+
+
+def _chunk(table: pa.Table) -> list:
+    return table.to_batches(max_chunksize=BATCH_ROWS)
+
+
+class FragmentStore:
+    """Thread-safe fragment-result store with a resident-bytes budget.
+
+    `put` accepts an optional partition spec (key column indices, bucket
+    count): the result is hash-partitioned ONCE at store time and per-bucket
+    rows/bytes metadata recorded, so every later bucket request is a slice,
+    not a scan. When resident bytes exceed the budget, whole results spill
+    (oldest first) to Arrow IPC files in a private temp dir and are served
+    batch-at-a-time off disk — the budget bounds worker RSS, not result size."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if budget_bytes is None:
+            budget_bytes = int(os.environ.get(STORE_BUDGET_ENV,
+                                              DEFAULT_STORE_BUDGET))
+        self.budget_bytes = max(budget_bytes, 1 << 20)
+        self._entries: dict[str, _Stored] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._tmpdir: Optional[str] = None
+
+    # --- writes ---
+
+    def put(self, frag_id: str, table: pa.Table,
+            partition: Optional[tuple[list[int], int]] = None) -> _Stored:
+        if partition is not None:
+            keys, nb = partition
+            slices = partition_table(table, list(keys), nb)
+            batches, ranges, meta = [], [], []
+            for s in slices:
+                bs = _chunk(s)
+                ranges.append((len(batches), len(bs)))
+                batches.extend(bs)
+                meta.append({"rows": s.num_rows,
+                             "bytes": sum(b.nbytes for b in bs)})
+            tracing.counter("exchange.partitions")
+            tracing.counter("exchange.partition_rows", table.num_rows)
+            ent = _Stored(schema=table.schema, batches=batches,
+                          nbytes=sum(b.nbytes for b in batches),
+                          nbuckets=nb, ranges=ranges, meta=meta,
+                          rows=table.num_rows)
+        else:
+            batches = _chunk(table)
+            ent = _Stored(schema=table.schema, batches=batches,
+                          nbytes=sum(b.nbytes for b in batches),
+                          rows=table.num_rows)
+        with self._lock:
+            self._seq += 1
+            ent.seq = self._seq
+            self._entries[frag_id] = ent
+            self._enforce_budget_locked()
+        return ent
+
+    def _enforce_budget_locked(self) -> None:
+        while self.resident_bytes_locked() > self.budget_bytes:
+            resident = [(e.seq, fid) for fid, e in self._entries.items()
+                        if e.batches is not None]
+            if len(resident) == 0:
+                return
+            _, fid = min(resident)
+            self._spill_locked(fid)
+
+    def resident_bytes_locked(self) -> int:
+        return sum(e.nbytes for e in self._entries.values()
+                   if e.batches is not None)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self.resident_bytes_locked()
+
+    def _spill_locked(self, frag_id: str) -> None:
+        ent = self._entries[frag_id]
+        if self._tmpdir is None:
+            self._tmpdir = tempfile.mkdtemp(prefix="igloo-fragstore-")
+        path = os.path.join(self._tmpdir, f"{frag_id}.arrow".replace("/", "_"))
+        with pa.OSFile(path, "wb") as f, \
+                pa.ipc.new_file(f, ent.schema) as w:
+            for b in ent.batches:
+                w.write_batch(b)
+        ent.spill_path = path
+        ent.batches = None
+        tracing.counter("exchange.spills")
+        tracing.counter("exchange.spill_bytes", ent.nbytes)
+
+    def release(self, ids: list[str]) -> None:
+        with self._lock:
+            for fid in ids:
+                ent = self._entries.pop(fid, None)
+                if ent is not None and ent.spill_path:
+                    try:
+                        os.unlink(ent.spill_path)
+                    except OSError:
+                        pass
+
+    # --- reads ---
+
+    def __contains__(self, frag_id: str) -> bool:
+        with self._lock:
+            return frag_id in self._entries
+
+    def ids(self) -> list[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def bucket_meta(self, frag_id: str) -> Optional[list]:
+        with self._lock:
+            ent = self._entries.get(frag_id)
+            return list(ent.meta) if ent is not None and ent.meta else None
+
+    def _entry_range(self, frag_id: str, bucket: Optional[int],
+                     nbuckets: Optional[int]):
+        ent = self._entries.get(frag_id)
+        if ent is None:
+            raise KeyError(frag_id)
+        if bucket is None:
+            return ent, 0, -1  # -1 = every batch
+        if ent.nbuckets is None:
+            raise ValueError(f"fragment {frag_id} is not hash-partitioned")
+        if nbuckets is not None and nbuckets != ent.nbuckets:
+            raise ValueError(
+                f"fragment {frag_id} partitioned into {ent.nbuckets} "
+                f"buckets, request asked for {nbuckets}")
+        if not 0 <= bucket < ent.nbuckets:
+            raise ValueError(f"bucket {bucket} out of range")
+        start, count = ent.ranges[bucket]
+        return ent, start, count
+
+    def stream(self, frag_id: str, bucket: Optional[int] = None,
+               nbuckets: Optional[int] = None
+               ) -> tuple[pa.Schema, Iterator]:
+        """(schema, batch iterator) for a fragment result or one bucket slice.
+        Resident entries iterate their in-memory batches; spilled entries read
+        one batch at a time from the IPC file (plain buffered reads, NOT a
+        memory map: mapped pages would count against this process's RSS for
+        the whole stream, defeating the budget), so serving never
+        re-materializes the whole result."""
+        with self._lock:
+            ent, start, count = self._entry_range(frag_id, bucket, nbuckets)
+            batches = list(ent.batches) if ent.batches is not None else None
+            spill = ent.spill_path
+
+        def gen():
+            if batches is not None:
+                sel = batches if count < 0 else batches[start:start + count]
+                for b in sel:
+                    yield b
+                return
+            src = pa.OSFile(spill, "rb")
+            try:
+                reader = pa.ipc.open_file(src)
+                n = reader.num_record_batches if count < 0 else count
+                s = 0 if count < 0 else start
+                for i in range(s, s + n):
+                    yield reader.get_batch(i)
+            finally:
+                src.close()
+        return ent.schema, gen()
+
+    def get_table(self, frag_id: str, bucket: Optional[int] = None,
+                  nbuckets: Optional[int] = None) -> pa.Table:
+        schema, it = self.stream(frag_id, bucket, nbuckets)
+        return pa.Table.from_batches(list(it), schema=schema)

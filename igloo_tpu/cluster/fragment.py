@@ -18,10 +18,20 @@ partition parallelism — the latent axis the reference never exploits, SURVEY
 §2 parallelism table); non-leaf fragments round-robin across workers instead
 of always running on the coordinator (distributed_planner.rs:65-92 pins every
 join to "coordinator").
+
+Shuffle joins (the reference's declared-but-dead FragmentType::Shuffle,
+fragment.rs:12): an equi-join whose sides are both local subtrees becomes a
+HASH-PARTITIONED EXCHANGE instead of a union onto one worker. Each side's
+scan fragments get an `Exchange` root (the worker hash-partitions the result
+by the join keys into B buckets at store time), and B per-bucket join
+fragments — spread across workers — each read only bucket b of EVERY input
+fragment via bucketed do_get tickets. Join compute and network traffic both
+scale with worker count; the consumer unions the B join-fragment results.
 """
 from __future__ import annotations
 
 import itertools
+import os
 import uuid
 from dataclasses import dataclass, field
 from typing import Optional
@@ -30,8 +40,15 @@ from igloo_tpu import types as T
 from igloo_tpu.cluster import serde
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
+from igloo_tpu.sql.ast import JoinType
 
 FRAG_PREFIX = "__frag_"
+
+# join types a hash-partitioned exchange preserves: every row routes to
+# exactly one bucket and matching keys co-locate, so inner/outer/semi/anti
+# semantics are all per-bucket local. CROSS has no keys to partition by.
+_SHUFFLE_JOIN_TYPES = {JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
+                       JoinType.FULL, JoinType.SEMI, JoinType.ANTI}
 
 
 @dataclass
@@ -43,6 +60,8 @@ class QueryFragment:
     worker: str = ""
     deps: list[str] = field(default_factory=list)
     schema: Optional[T.Schema] = None
+    kind: str = ""                   # "scan" | "exchange" | "join" | "root"
+    bucket: Optional[int] = None     # per-bucket join fragment's bucket id
 
     def is_ready(self, completed: set[str]) -> bool:
         return all(d in completed for d in self.deps)
@@ -53,6 +72,44 @@ def _frag_scan(frag: "QueryFragment") -> L.LogicalPlan:
     s = L.Scan(table=FRAG_PREFIX + frag.id, provider=None)
     s.schema = frag.schema
     return s
+
+
+def _bucket_scan(frag: "QueryFragment", bucket: int, buckets: int
+                 ) -> L.LogicalPlan:
+    """A plan node reading ONE hash bucket of a dependency fragment's
+    Exchange-partitioned result."""
+    s = L.Scan(table=FRAG_PREFIX + frag.id, provider=None,
+               bucket=bucket, buckets=buckets)
+    s.schema = frag.schema
+    return s
+
+
+def _bucket_union(side_frags: list, bucket: int, buckets: int,
+                  schema: T.Schema) -> L.LogicalPlan:
+    children = [_bucket_scan(f, bucket, buckets) for f in side_frags]
+    if len(children) == 1:
+        return children[0]
+    u = L.Union(inputs=children)
+    u.schema = schema
+    return u
+
+
+def _plain_key_indices(keys: list, schema: T.Schema) -> Optional[list[int]]:
+    """Join keys as plain column indices into the side's output schema, or
+    None when any key is a computed expression (then the two sides' raw
+    column bytes need not agree and hash co-partitioning is unsound)."""
+    idxs = []
+    for k in keys:
+        if type(k) is not E.Column or k.index is None or \
+                not 0 <= k.index < len(schema.fields):
+            return None
+        idxs.append(k.index)
+    return idxs
+
+
+def _copy_expr(e):
+    import copy
+    return copy.deepcopy(e) if e is not None else None
 
 
 def _col(i: int, dtype: T.DataType, name: str = "") -> E.Expr:
@@ -103,12 +160,21 @@ _DECOMPOSABLE = {E.AggFunc.SUM, E.AggFunc.MIN, E.AggFunc.MAX, E.AggFunc.COUNT,
 class DistributedPlanner:
     """Fragments an optimized plan across `workers` (list of addresses)."""
 
-    def __init__(self, workers: list[str], partitions_per_worker: int = 1):
+    def __init__(self, workers: list[str], partitions_per_worker: int = 1,
+                 shuffle_buckets: Optional[int] = None):
         if not workers:
             raise ValueError("no workers")
         self.workers = list(workers)
         self.ppw = partitions_per_worker
         self._rr = itertools.cycle(range(len(workers)))
+        if shuffle_buckets is None:
+            env = os.environ.get("IGLOO_SHUFFLE_BUCKETS")
+            shuffle_buckets = int(env) if env else \
+                len(self.workers) * self.ppw
+        self.shuffle_buckets = max(1, shuffle_buckets)
+        # kill switch for A/B against the union-onto-one-worker plan shape
+        self.shuffle_enabled = \
+            os.environ.get("IGLOO_SHUFFLE_JOIN", "1") != "0"
 
     def plan(self, plan: L.LogicalPlan) -> list[QueryFragment]:
         """-> fragments in dependency-safe order; the LAST one is the root."""
@@ -125,14 +191,21 @@ class DistributedPlanner:
     def _make_fragment(self, plan: L.LogicalPlan,
                        frags_out: list[QueryFragment],
                        deps: Optional[list[str]] = None,
-                       worker: Optional[str] = None) -> QueryFragment:
+                       worker: Optional[str] = None,
+                       kind: str = "",
+                       bucket: Optional[int] = None) -> QueryFragment:
         plan_json = serde.plan_to_json(plan)
         if deps is None:
-            deps = [d["table"][len(FRAG_PREFIX):]
-                    for d in _frag_refs(plan_json)]
+            # dedupe, preserving order: a per-bucket join fragment references
+            # the same dependency once per side scan
+            seen: dict[str, None] = {}
+            for d in _frag_refs(plan_json):
+                seen.setdefault(d["table"][len(FRAG_PREFIX):])
+            deps = list(seen)
         f = QueryFragment(id=uuid.uuid4().hex[:12], plan=plan_json,
                           worker=worker or self._next_worker(),
-                          deps=deps, schema=plan.schema)
+                          deps=deps, schema=plan.schema, kind=kind,
+                          bucket=bucket)
         frags_out.append(f)
         return f
 
@@ -153,11 +226,78 @@ class DistributedPlanner:
         if isinstance(p, L.Union):
             p.inputs = [self._split(c, frags) for c in p.inputs]
         if isinstance(p, L.Join):
+            shuffled = self._try_shuffle_join(p, frags)
+            if shuffled is not None:
+                return shuffled
             for name in ("left", "right"):
                 ch = getattr(p, name)
                 if _is_local(ch) and not isinstance(ch, L.Values):
                     setattr(p, name, self._scan_fragments(ch, frags))
         return p
+
+    # --- hash-partitioned shuffle joins ---
+
+    def _try_shuffle_join(self, p: L.Join,
+                          frags: list[QueryFragment]
+                          ) -> Optional[L.LogicalPlan]:
+        """Join over two local subtrees -> per-bucket join fragments reading
+        bucket slices of Exchange-partitioned side fragments; returns the
+        Union the consumer executes, or None when ineligible (the caller
+        falls back to the union-of-scan-fragments shape)."""
+        if not self.shuffle_enabled or len(self.workers) < 2 \
+                or self.shuffle_buckets < 2:
+            return None
+        if p.join_type not in _SHUFFLE_JOIN_TYPES or not p.left_keys:
+            return None
+        for side in (p.left, p.right):
+            if not _is_local(side) or isinstance(side, L.Values) \
+                    or side.schema is None:
+                return None
+        lkeys = _plain_key_indices(p.left_keys, p.left.schema)
+        rkeys = _plain_key_indices(p.right_keys, p.right.schema)
+        if lkeys is None or rkeys is None:
+            return None
+        # both sides must hash the same value domain: binder coercion casts
+        # (non-Column keys) are already rejected above, this guards direct
+        # Column pairs of unequal dtype
+        for lk, rk in zip(p.left_keys, p.right_keys):
+            if lk.dtype is None or rk.dtype is None or \
+                    lk.dtype.id is not rk.dtype.id:
+                return None
+        B = self.shuffle_buckets
+        left_frags = self._exchange_fragments(p.left, lkeys, B, frags)
+        right_frags = self._exchange_fragments(p.right, rkeys, B, frags)
+        join_scans: list[L.LogicalPlan] = []
+        for b in range(B):
+            jb = L.Join(left=_bucket_union(left_frags, b, B, p.left.schema),
+                        right=_bucket_union(right_frags, b, B, p.right.schema),
+                        join_type=p.join_type,
+                        left_keys=[_copy_expr(k) for k in p.left_keys],
+                        right_keys=[_copy_expr(k) for k in p.right_keys],
+                        residual=_copy_expr(p.residual))
+            jb.schema = p.schema
+            jf = self._make_fragment(
+                jb, frags, worker=self.workers[b % len(self.workers)],
+                kind="join", bucket=b)
+            join_scans.append(_frag_scan(jf))
+        if len(join_scans) == 1:
+            return join_scans[0]
+        u = L.Union(inputs=join_scans)
+        u.schema = p.schema
+        return u
+
+    def _exchange_fragments(self, side: L.LogicalPlan, keys: list[int],
+                            buckets: int,
+                            frags: list[QueryFragment]) -> list[QueryFragment]:
+        """One Exchange-rooted fragment per scan partition set of `side`."""
+        out = []
+        for part in self._partition_sets(side):
+            sub = _with_partition(side, part) if part else L.copy_plan(side)
+            ex = L.Exchange(input=sub, keys=list(keys), buckets=buckets)
+            ex.schema = sub.schema
+            out.append(self._make_fragment(ex, frags, deps=[],
+                                           kind="exchange"))
+        return out
 
     def _scan_fragments(self, subtree: L.LogicalPlan,
                         frags: list[QueryFragment]) -> L.LogicalPlan:

@@ -150,11 +150,26 @@ def flight_action_raw(addr: str, name: str,
     return results[0].body.to_pybytes() if results else b""
 
 
-def flight_get_table(addr: str, ticket: str):
-    """One-shot do_get RPC returning the full Arrow table."""
+def flight_stream_batches(addr: str, ticket):
+    """Streaming do_get: returns (schema, record-batch generator). The
+    connection stays open until the generator is exhausted (or closed), so
+    the consumer holds at most one in-flight batch instead of the whole
+    result — the data-plane half of the fragment tier's streaming transfers.
+    `ticket` may be str or bytes (bucketed exchange tickets are JSON)."""
+    raw = ticket if isinstance(ticket, bytes) else ticket.encode()
     client = flight.connect(normalize(addr))
     try:
-        return client.do_get(flight.Ticket(ticket.encode()),
-                             call_options()).read_all()
-    finally:
+        reader = client.do_get(flight.Ticket(raw), call_options())
+        schema = reader.schema
+    except Exception:
         client.close()
+        raise
+
+    def gen():
+        try:
+            for chunk in reader:
+                if chunk.data is not None:
+                    yield chunk.data
+        finally:
+            client.close()
+    return schema, gen()

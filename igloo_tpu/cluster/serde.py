@@ -167,6 +167,8 @@ def plan_to_json(p: L.LogicalPlan) -> dict:
                  pushed=[expr_to_json(f) for f in p.pushed_filters],
                  partition=getattr(p, "partition", None),
                  partition_token=getattr(p, "partition_token", None))
+        if getattr(p, "bucket", None) is not None:
+            d.update(bucket=p.bucket, buckets=p.buckets)
     elif isinstance(p, L.Filter):
         d.update(input=plan_to_json(p.input), predicate=expr_to_json(p.predicate))
     elif isinstance(p, L.Project):
@@ -204,6 +206,9 @@ def plan_to_json(p: L.LogicalPlan) -> dict:
                  anti=p.anti)
     elif isinstance(p, L.Values):
         d.update(rows=[list(r) for r in p.rows])
+    elif isinstance(p, L.Exchange):
+        d.update(input=plan_to_json(p.input), keys=list(p.keys),
+                 buckets=p.buckets)
     else:
         raise PlanError(f"cannot serialize plan node {type(p).__name__}")
     return d
@@ -221,6 +226,9 @@ def plan_from_json(d: dict, catalog) -> L.LogicalPlan:
         if d.get("partition") is not None:
             p.partition = tuple(d["partition"])  # type: ignore[attr-defined]
         p.partition_token = d.get("partition_token")  # type: ignore[attr-defined]
+        if d.get("bucket") is not None:
+            p.bucket = d["bucket"]    # type: ignore[attr-defined]
+            p.buckets = d["buckets"]  # type: ignore[attr-defined]
     elif t == "Filter":
         p = L.Filter(input=plan_from_json(d["input"], catalog),
                      predicate=_rx(d["predicate"], catalog))
@@ -266,6 +274,9 @@ def plan_from_json(d: dict, catalog) -> L.LogicalPlan:
                         anti=d["anti"])
     elif t == "Values":
         p = L.Values(rows=[list(r) for r in d["rows"]])
+    elif t == "Exchange":
+        p = L.Exchange(input=plan_from_json(d["input"], catalog),
+                       keys=list(d["keys"]), buckets=d["buckets"])
     else:
         raise PlanError(f"cannot deserialize plan node {t}")
     p.schema = schema
@@ -304,7 +315,10 @@ def provider_to_spec(provider) -> Optional[dict]:
         return {"kind": "iceberg", "path": provider.path}
     if isinstance(provider, MemTable):
         import base64
-        return {"kind": "ipc",
+        # partition count rides along: the planner strides the COORDINATOR
+        # provider's partitions, so a worker rebuilding the table must slice
+        # read_partition identically or striped scans return wrong rows
+        return {"kind": "ipc", "partitions": provider.num_partitions(),
                 "data": base64.b64encode(table_to_ipc(provider.read())).decode()}
     return None
 
@@ -324,7 +338,8 @@ def provider_from_spec(spec: dict):
     if kind == "ipc":
         import base64
         from igloo_tpu.catalog import MemTable
-        return MemTable(table_from_ipc(base64.b64decode(spec["data"])))
+        return MemTable(table_from_ipc(base64.b64decode(spec["data"])),
+                        partitions=spec.get("partitions", 1))
     raise PlanError(f"unknown provider spec kind: {kind}")
 
 

@@ -11,6 +11,13 @@ the worker<->worker transport the reference declared via GetDataForTask and
 never built), runs the plan on its local device tier, and serves the result as
 an Arrow Flight stream.
 
+Results live in a bytes-budgeted `FragmentStore` (cluster/exchange.py): an
+`Exchange`-rooted fragment hash-partitions its result at store time, and
+`do_get` tickets address either a whole fragment or ONE bucket slice — the
+per-bucket transport that lets a join fragment fetch only its bucket of each
+peer's result instead of the whole table. Transfers stream record-batch-wise
+in both directions.
+
 Transport is Arrow Flight end-to-end (one stack for control actions and data
 streams) instead of the reference's parallel tonic-gRPC + Flight pair.
 """
@@ -26,13 +33,23 @@ import pyarrow as pa
 import pyarrow.flight as flight
 
 from igloo_tpu.catalog import Catalog, MemTable
-from igloo_tpu.cluster import serde
-from igloo_tpu.cluster.fragment import FRAG_PREFIX
+from igloo_tpu.cluster import exchange, serde
+from igloo_tpu.cluster.fragment import FRAG_PREFIX, _frag_refs
 from igloo_tpu.cluster import rpc
-from igloo_tpu.cluster.rpc import flight_action, flight_get_table
+from igloo_tpu.cluster.rpc import flight_action, flight_stream_batches
 from igloo_tpu.cluster.rpc import normalize as _normalize
 from igloo_tpu.errors import IglooError
+from igloo_tpu.plan import logical as L
 from igloo_tpu.utils import tracing
+
+
+def _dep_key(frag_id: str, bucket) -> str:
+    """FragmentStore key for a peer-fetched dependency slice. With
+    bucket=None this is both the whole-result key and the prefix every slice
+    of that dependency shares (how `release` finds them); real fragment ids
+    are hex, so `__dep_*` keys cannot collide with produced results."""
+    base = f"__dep_{frag_id}:"
+    return base if bucket is None else f"{base}{bucket}"
 
 
 class _OverlayCatalog:
@@ -54,7 +71,8 @@ class WorkerServer(flight.FlightServerBase):
     on its own thread; the fragment store and engine state are lock-guarded."""
 
     def __init__(self, location: str, worker_id: Optional[str] = None,
-                 use_jit: bool = True, mesh: object = "default", **kw):
+                 use_jit: bool = True, mesh: object = "default",
+                 store_budget_bytes: Optional[int] = None, **kw):
         mw = rpc.server_middleware()
         if mw is not None:
             kw.setdefault("middleware", mw)
@@ -67,8 +85,10 @@ class WorkerServer(flight.FlightServerBase):
         self.worker_id = worker_id or uuid.uuid4().hex[:12]
         self.advertise: str = location
         self._catalog = Catalog()
-        self._results: dict[str, pa.Table] = {}
-        self._lock = threading.Lock()
+        # own results AND peer-fetched dependency slices (under `__dep_*`
+        # keys): one bucketed, bytes-budgeted, spill-backed store, so fetched
+        # slices count against the same RSS budget as produced results
+        self._store = exchange.FragmentStore(store_budget_bytes)
         self._use_jit = use_jit
         self._jit_cache: dict = {}
         self._mesh_setting = mesh  # same rule as QueryEngine (resolve_mesh)
@@ -96,49 +116,84 @@ class WorkerServer(flight.FlightServerBase):
         return Executor(self._jit_cache, use_jit=self._use_jit,
                         batch_cache=self._batch_cache)
 
-    def _fetch_dep(self, frag_id: str, addr: str) -> pa.Table:
-        with self._lock:
-            if frag_id in self._results:
-                return self._results[frag_id]
-        # peer fetch: the worker that executed the dependency streams it;
-        # an unreachable peer is reported with a marker the coordinator
-        # recognizes (it requeues the dependency on a live worker)
+    def _fetch_dep(self, frag_id: str, addr: str,
+                   bucket: Optional[int] = None,
+                   nbuckets: Optional[int] = None) -> pa.Table:
+        # own store first: a co-located dependency (or its bucket slice) is a
+        # zero-copy local read, not a transfer
+        if frag_id in self._store:
+            try:
+                return self._store.get_table(frag_id, bucket, nbuckets)
+            except (KeyError, ValueError) as ex:
+                raise IglooError(f"DEP_UNAVAILABLE:{frag_id} local: {ex}")
+        dep_key = _dep_key(frag_id, bucket)
+        if dep_key in self._store:
+            return self._store.get_table(dep_key)
+        # peer fetch: the worker that executed the dependency streams it
+        # batch-wise; an unreachable peer is reported with a marker the
+        # coordinator recognizes (it requeues the dependency on a live worker)
         try:
-            table = flight_get_table(addr, frag_id)
+            ticket = exchange.make_ticket(frag_id, bucket, nbuckets)
+            schema, batch_iter = flight_stream_batches(addr, ticket)
+            batches = []
+            for batch in batch_iter:
+                batches.append(batch)
+                tracing.counter("exchange.fetch_rows", batch.num_rows)
+                tracing.counter("exchange.fetch_bytes", batch.nbytes)
+            table = pa.Table.from_batches(batches, schema=schema)
         except Exception as ex:
             raise IglooError(f"DEP_UNAVAILABLE:{frag_id} peer {addr}: {ex}")
-        with self._lock:
-            # keep the local copy: co-located dependents reuse it instead of
-            # re-downloading; the coordinator's final "release" drops it
-            self._results[frag_id] = table
+        # keep the slice in the budgeted store: co-located dependents reuse
+        # it instead of re-downloading (it may spill under memory pressure);
+        # the coordinator's final "release" drops it
+        self._store.put(dep_key, table)
         return table
 
     def _execute_fragment(self, req: dict) -> dict:
         frag_id = req["id"]
+        addr_of = {d["id"]: d["addr"] for d in req.get("deps", [])}
         overlay: dict = {}
-        t_dep0 = time.perf_counter()
-        for dep in req.get("deps", []):
-            t = self._fetch_dep(dep["id"], dep["addr"])
-            overlay[(FRAG_PREFIX + dep["id"]).lower()] = MemTable(t)
-        dep_s = time.perf_counter() - t_dep0
-        catalog = _OverlayCatalog(self._catalog, overlay)
-        plan = serde.plan_from_json(req["plan"], catalog)
-        t0 = time.perf_counter()
+        input_rows = 0
         # per-fragment counter delta: thread-isolated, so concurrent
         # fragments on this worker report only their own transfers/compiles
         with tracing.counter_delta() as delta:
+            t_dep0 = time.perf_counter()
+            for ref in _frag_refs(req["plan"]):
+                dep_id = ref["table"][len(FRAG_PREFIX):]
+                name = ref["table"].lower()
+                if name in overlay:
+                    continue
+                t = self._fetch_dep(dep_id, addr_of.get(dep_id, ""),
+                                    ref.get("bucket"), ref.get("buckets"))
+                input_rows += t.num_rows
+                overlay[name] = MemTable(t)
+            dep_s = time.perf_counter() - t_dep0
+            catalog = _OverlayCatalog(self._catalog, overlay)
+            plan = serde.plan_from_json(req["plan"], catalog)
+            partition = None
+            if isinstance(plan, L.Exchange):
+                # fragment-root exchange: execute the input, hash-partition
+                # the result at store time (per-bucket slices + metadata)
+                partition = (plan.keys, plan.buckets)
+                plan = plan.input
+            t0 = time.perf_counter()
             table = self._executor().execute_to_arrow(plan)
-        elapsed = time.perf_counter() - t0
-        with self._lock:
-            self._results[frag_id] = table
+            elapsed = time.perf_counter() - t0
+            self._store.put(frag_id, table, partition=partition)
         tracing.counter("worker.fragments")
-        return {"id": frag_id, "rows": table.num_rows,
-                "elapsed_s": round(elapsed, 6), "worker": self.worker_id,
-                "dep_fetch_s": round(dep_s, 6),
-                "h2d_bytes": delta.get("xfer.h2d_bytes"),
-                "d2h_bytes": delta.get("xfer.d2h_bytes"),
-                "jit_misses": delta.get("jit.miss"),
-                "cache_hits": delta.get("cache.hit")}
+        out = {"id": frag_id, "rows": table.num_rows,
+               "elapsed_s": round(elapsed, 6), "worker": self.worker_id,
+               "dep_fetch_s": round(dep_s, 6),
+               "input_rows": input_rows,
+               "h2d_bytes": delta.get("xfer.h2d_bytes"),
+               "d2h_bytes": delta.get("xfer.d2h_bytes"),
+               "jit_misses": delta.get("jit.miss"),
+               "cache_hits": delta.get("cache.hit"),
+               "exchange_rows": delta.get("exchange.fetch_rows"),
+               "exchange_bytes": delta.get("exchange.fetch_bytes")}
+        if partition is not None:
+            out["buckets"] = partition[1]
+        return out
 
     # --- Flight surface ---
 
@@ -157,14 +212,16 @@ class WorkerServer(flight.FlightServerBase):
             self._batch_cache.invalidate_table(req["name"].lower())
             return [b"{}"]
         if action.type == "release":
-            with self._lock:
-                for fid in req.get("ids", []):
-                    self._results.pop(fid, None)
+            ids = req.get("ids", [])
+            deps = [k for k in self._store.ids()
+                    if any(k.startswith(_dep_key(fid, None)) for fid in ids)]
+            self._store.release(ids + deps)
             return [b"{}"]
         if action.type == "ping":
+            own = [i for i in self._store.ids() if not i.startswith("__dep_")]
             return [json.dumps({"worker": self.worker_id,
                                 "tables": sorted(self._catalog.names()),
-                                "fragments": len(self._results)}).encode()]
+                                "fragments": len(own)}).encode()]
         if action.type == "metrics":
             # Prometheus text exposition of this worker process's registry
             # (raw bytes, not JSON — scrape via rpc.flight_action_raw)
@@ -179,12 +236,22 @@ class WorkerServer(flight.FlightServerBase):
                 ("metrics", "process metrics, Prometheus text format")]
 
     def do_get(self, context, ticket):
-        frag_id = ticket.ticket.decode()
-        with self._lock:
-            table = self._results.get(frag_id)
-        if table is None:
+        frag_id, bucket, nbuckets = exchange.parse_ticket(ticket.ticket)
+        try:
+            schema, batches = self._store.stream(frag_id, bucket, nbuckets)
+        except KeyError:
             raise flight.FlightServerError(f"no such fragment: {frag_id}")
-        return flight.RecordBatchStream(table)
+        except ValueError as ex:
+            raise flight.FlightServerError(f"bad bucket request: {ex}")
+
+        def counted():
+            for b in batches:
+                tracing.counter("exchange.rows", b.num_rows)
+                tracing.counter("exchange.bytes", b.nbytes)
+                yield b
+        # GeneratorStream: one in-flight batch, never the whole table — a
+        # spilled fragment streams straight off its IPC spill file
+        return flight.GeneratorStream(schema, counted())
 
 
 class Worker:
@@ -192,8 +259,10 @@ class Worker:
 
     def __init__(self, coordinator: str, host: str = "127.0.0.1",
                  port: int = 0, heartbeat_interval_s: float = 5.0,
-                 use_jit: bool = True):
-        self.server = WorkerServer(f"grpc+tcp://{host}:{port}", use_jit=use_jit)
+                 use_jit: bool = True,
+                 store_budget_bytes: Optional[int] = None):
+        self.server = WorkerServer(f"grpc+tcp://{host}:{port}", use_jit=use_jit,
+                                   store_budget_bytes=store_budget_bytes)
         self.server.advertise = f"grpc+tcp://{host}:{self.server.port}"
         self.coordinator = _normalize(coordinator)
         self.heartbeat_interval_s = heartbeat_interval_s

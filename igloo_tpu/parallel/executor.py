@@ -48,7 +48,8 @@ from igloo_tpu.parallel.mesh import (
     ROWS, is_row_sharded, make_mesh, replicate, shard_rows,
 )
 from igloo_tpu.parallel.shuffle import (
-    default_bucket_cap, hash_to_dest, shuffle_batch_local,
+    broadcast_batch_local, default_bucket_cap, hash_to_dest,
+    should_broadcast, shuffle_batch_local,
 )
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
@@ -699,48 +700,85 @@ class ShardedExecutor(Executor):
 
         lcap_local = left.capacity // n
         rcap_local = right.capacity // n
-        lbucket = default_bucket_cap(lcap_local, n)
-        rbucket = default_bucket_cap(rcap_local, n)
-        match_cap = round_capacity(n * max(lbucket, rbucket))
-        # output capacity: per-shard share of an FK join is ~the probe share;
-        # 2x headroom for skew, overflow -> exact re-run
-        out_cap_local = max(8, 2 * max(lcap_local, rcap_local))
 
         from igloo_tpu.exec.join import _key_lanes
 
-        def local_fn(l, r, consts):
-            env_dest_l = _key_lanes(l, lk, lhx, consts)
-            env_dest_r = _key_lanes(r, rk, rhx, consts)
-            lh = K.hash_lanes([h for kl in env_dest_l for h in kl.hash_ints],
-                              [kl.null for kl in env_dest_l
-                               for _ in kl.hash_ints])
-            rh = K.hash_lanes([h for kl in env_dest_r for h in kl.hash_ints],
-                              [kl.null for kl in env_dest_r
-                               for _ in kl.hash_ints])
-            l2, ovl = shuffle_batch_local(l, hash_to_dest(lh, n), n, lbucket,
-                                          ROWS)
-            r2, ovr = shuffle_batch_local(r, hash_to_dest(rh, n), n, rbucket,
-                                          ROWS)
-            p = probe_phase(l2, r2, lk, rk, lhx, rhx, consts)
-            out = expand_phase(l2, r2, p, match_cap, jt, residual,
-                               plan.schema, consts)
-            ovm = p.total > match_cap
-            # bound output capacity per shard
-            perm = K.compact_perm(out.live)
-            n_out = jnp.sum(out.live)
-            out = K.resize_batch(K.apply_perm(out, perm), out_cap_local)
-            ovo = n_out > out_cap_local
-            overflow = jax.lax.psum(
-                (ovl | ovr | ovm | ovo).astype(jnp.int32), ROWS) > 0
-            return out, overflow
+        if jt in (JoinType.INNER, JoinType.LEFT, JoinType.SEMI,
+                  JoinType.ANTI) and \
+                should_broadcast(left.capacity, right.capacity, n):
+            # broadcast join (skew escape hatch, parallel/shuffle.py rule):
+            # replicate the build side, never shuffle the probe side — a hot
+            # probe key stays spread across the devices that hold it. Build-
+            # side unmatched rows are never emitted for these join types, so
+            # replication cannot duplicate output.
+            match_cap = round_capacity(
+                max(8, 2 * max(lcap_local, rcap_local * n)))
+            out_cap_local = max(8, 2 * lcap_local)
+            tracing.counter("join.broadcast")
 
-        fp = ("shjoin", expr_fingerprint(lres + rres + rres2), jt,
-              batch_proto_key(left), batch_proto_key(right),
-              pool.signature(), marks, n, lbucket, rbucket, match_cap,
-              out_cap_local, plan.schema)
+            def local_fn(l, r, consts):
+                r2 = broadcast_batch_local(r, ROWS)
+                p = probe_phase(l, r2, lk, rk, lhx, rhx, consts)
+                out = expand_phase(l, r2, p, match_cap, jt, residual,
+                                   plan.schema, consts)
+                ovm = p.total > match_cap
+                perm = K.compact_perm(out.live)
+                n_out = jnp.sum(out.live)
+                out = K.resize_batch(K.apply_perm(out, perm), out_cap_local)
+                ovo = n_out > out_cap_local
+                overflow = jax.lax.psum(
+                    (ovm | ovo).astype(jnp.int32), ROWS) > 0
+                return out, overflow
+
+            fp = ("bjoin", expr_fingerprint(lres + rres + rres2), jt,
+                  batch_proto_key(left), batch_proto_key(right),
+                  pool.signature(), marks, n, match_cap, out_cap_local,
+                  plan.schema)
+            kind = "bjoin"
+        else:
+            lbucket = default_bucket_cap(lcap_local, n)
+            rbucket = default_bucket_cap(rcap_local, n)
+            match_cap = round_capacity(n * max(lbucket, rbucket))
+            # output capacity: per-shard share of an FK join is ~the probe
+            # share; 2x headroom for skew, overflow -> exact re-run
+            out_cap_local = max(8, 2 * max(lcap_local, rcap_local))
+
+            def local_fn(l, r, consts):
+                env_dest_l = _key_lanes(l, lk, lhx, consts)
+                env_dest_r = _key_lanes(r, rk, rhx, consts)
+                lh = K.hash_lanes([h for kl in env_dest_l
+                                   for h in kl.hash_ints],
+                                  [kl.null for kl in env_dest_l
+                                   for _ in kl.hash_ints])
+                rh = K.hash_lanes([h for kl in env_dest_r
+                                   for h in kl.hash_ints],
+                                  [kl.null for kl in env_dest_r
+                                   for _ in kl.hash_ints])
+                l2, ovl = shuffle_batch_local(l, hash_to_dest(lh, n), n,
+                                              lbucket, ROWS)
+                r2, ovr = shuffle_batch_local(r, hash_to_dest(rh, n), n,
+                                              rbucket, ROWS)
+                p = probe_phase(l2, r2, lk, rk, lhx, rhx, consts)
+                out = expand_phase(l2, r2, p, match_cap, jt, residual,
+                                   plan.schema, consts)
+                ovm = p.total > match_cap
+                # bound output capacity per shard
+                perm = K.compact_perm(out.live)
+                n_out = jnp.sum(out.live)
+                out = K.resize_batch(K.apply_perm(out, perm), out_cap_local)
+                ovo = n_out > out_cap_local
+                overflow = jax.lax.psum(
+                    (ovl | ovr | ovm | ovo).astype(jnp.int32), ROWS) > 0
+                return out, overflow
+
+            fp = ("shjoin", expr_fingerprint(lres + rres + rres2), jt,
+                  batch_proto_key(left), batch_proto_key(right),
+                  pool.signature(), marks, n, lbucket, rbucket, match_cap,
+                  out_cap_local, plan.schema)
+            kind = "shjoin"
         consts = pool.device_args()
         out, overflow = self._jitted_shard_map(
-            "shjoin", fp,
+            kind, fp,
             lambda l, r, c: local_fn(l, r, c),
             out_specs=(P(ROWS), P()), n_batch_args=2)(
             strip_dicts(left), strip_dicts(right), consts)

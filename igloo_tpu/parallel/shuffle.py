@@ -20,6 +20,33 @@ run exceeds its bucket. With `bucket_cap = L` overflow is impossible (a source
 only has L rows); smaller buckets trade memory for a deferred overflow check
 (the executor re-runs with safe buckets if the flag fires — same deferred
 machinery as speculative join expand, exec/executor.py).
+
+PATHOLOGICAL SKEW RULE: hash partitioning cannot bound the per-device load
+when one hot key carries more rows than a bucket — every occurrence of the
+key hashes to the SAME destination, so growing `bucket_cap` only delays the
+overflow until the cap reaches its safe bound L, at which point the hot
+destination simply holds (almost) everything and the downstream match/output
+capacities blow up instead. Re-running the shuffle with bigger buckets is
+therefore unwinnable; the escape hatches are to not shuffle the skewed side
+at all:
+
+- **broadcast** (`broadcast_batch_local` + `should_broadcast`): replicate the
+  BUILD side with one `all_gather` and leave the probe side un-shuffled.
+  Probe-side skew becomes harmless (a hot probe key stays spread across the
+  devices that already hold it) and a hot build key replicates like any other
+  build row. Valid for INNER/LEFT/SEMI/ANTI (build-side unmatched rows are
+  never emitted, so replication cannot duplicate output); chosen up front by
+  `should_broadcast` whenever replicating the build side moves no more bytes
+  than hash-exchanging both sides would.
+- **gathered exact** (the `_exact_copy` re-run): the last resort when the
+  build side is too big to replicate — both sides gather and the
+  single-device exact join runs with synced capacities. This terminates by
+  construction, so the overflow ladder is shuffle -> (broadcast, if eligible
+  at plan time) -> gathered exact, never a re-shuffle loop.
+
+(Splitting the hot key across devices and re-merging is the other textbook
+fix; it needs a per-key histogram sync, which costs more than the broadcast
+on every workload we generate, so it is documented here and not built.)
 """
 from __future__ import annotations
 
@@ -105,6 +132,44 @@ def shuffle_batch_local(batch, dest: jax.Array, n_dev: int, bucket_cap: int,
     cols = [DeviceColumn(c.dtype, v, nl, None)
             for c, v, nl in zip(batch.columns, out_lanes, out_nulls)]
     return DeviceBatch(batch.schema, cols, out_live), overflow
+
+
+def should_broadcast(probe_cap: int, build_cap: int, n_dev: int) -> bool:
+    """Broadcast-join decision (see PATHOLOGICAL SKEW RULE above): replicate
+    the build side when doing so ships no more rows than an all_to_all of
+    both sides (~probe_cap + build_cap). all_gather ships build_cap * n_dev
+    rows, so the rule is `build_cap * (n_dev - 1) <= probe_cap` with a small
+    floor so tiny build sides always broadcast."""
+    if n_dev <= 1:
+        return False
+    return build_cap * (n_dev - 1) <= max(probe_cap, 64 * n_dev)
+
+
+def broadcast_lanes(lanes: list, nulls: list, live: jax.Array,
+                    axis_name: str):
+    """Replicate a (local-view) side to every device with one all_gather per
+    lane: output lanes are [n_dev * L]. No overflow flag — replication is
+    shape-exact by construction, which is exactly why it is the skew escape
+    hatch."""
+    def g(x):
+        return jax.lax.all_gather(x, axis_name, tiled=True)
+    return ([g(l) for l in lanes],
+            [g(nl) if nl is not None else None for nl in nulls],
+            g(live))
+
+
+def broadcast_batch_local(batch, axis_name: str):
+    """Local-view (inside shard_map) DeviceBatch broadcast: every device ends
+    up holding ALL rows of `batch`. Dictionaries are host metadata and are
+    re-attached by the executor outside the traced function."""
+    from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn
+    lanes = [c.values for c in batch.columns]
+    nulls = [c.nulls for c in batch.columns]
+    out_lanes, out_nulls, out_live = broadcast_lanes(
+        lanes, nulls, batch.live, axis_name)
+    cols = [DeviceColumn(c.dtype, v, nl, None)
+            for c, v, nl in zip(batch.columns, out_lanes, out_nulls)]
+    return DeviceBatch(batch.schema, cols, out_live)
 
 
 def hash_to_dest(hash_lane: jax.Array, n_dev: int) -> jax.Array:

@@ -49,11 +49,18 @@ class Scan(LogicalPlan):
     # verified before partitioned reads (a re-globbed index of the same length
     # must not silently remap partition ids)
     partition_token: Optional[str] = None
+    # fragment-tier bucket scan: read only hash bucket `bucket` of `buckets`
+    # from a dependency fragment's Exchange-partitioned result (the worker's
+    # dep fetch resolves these into bucketed do_get tickets); None = whole
+    # result. Only meaningful on `__frag_*` scans.
+    bucket: Optional[int] = None
+    buckets: Optional[int] = None
 
     def node_name(self):
         cols = f" cols={self.projection}" if self.projection is not None else ""
         part = f" part={list(self.partition)}" if self.partition is not None else ""
-        return f"Scan({self.table}{cols}{part})"
+        bk = f" bucket={self.bucket}/{self.buckets}" if self.bucket is not None else ""
+        return f"Scan({self.table}{cols}{part}{bk})"
 
 
 @dataclass
@@ -200,6 +207,24 @@ class Values(LogicalPlan):
     rows: list[list[object]] = field(default_factory=list)  # python values
 
 
+@dataclass
+class Exchange(LogicalPlan):
+    """Hash-partition marker at a FRAGMENT root (distributed planner only —
+    the reference's never-built FragmentType::Shuffle, fragment.rs:12): the
+    worker executes `input`, then hash-partitions the result by the key
+    columns (indices into the input schema) into `buckets` bucket slices
+    served via bucketed do_get tickets. Never reaches a local executor."""
+    input: LogicalPlan = None  # type: ignore[assignment]
+    keys: list[int] = field(default_factory=list)
+    buckets: int = 1
+
+    def children(self):
+        return [self.input]
+
+    def node_name(self):
+        return f"Exchange(keys={self.keys}, buckets={self.buckets})"
+
+
 def copy_plan(plan: LogicalPlan) -> LogicalPlan:
     """Structural copy of a plan tree: nodes and expressions are fresh objects
     (safe for in-place optimizer rewrites), table providers are shared. Needed
@@ -243,6 +268,9 @@ def copy_plan(plan: LogicalPlan) -> LogicalPlan:
         n.nulls_first = list(n.nulls_first)
     elif isinstance(n, (Limit, Distinct)):
         n.input = copy_plan(n.input)
+    elif isinstance(n, Exchange):
+        n.input = copy_plan(n.input)
+        n.keys = list(n.keys)
     elif isinstance(n, Union):
         n.inputs = [copy_plan(c) for c in n.inputs]
     elif isinstance(n, SetOpJoin):

@@ -15,6 +15,9 @@ else
   echo "ruff not installed here; skipping lint (CI runs it)"
 fi
 
+echo "== 2-worker shuffle-join smoke (fragment-tier exchange) =="
+python scripts/shuffle_smoke.py
+
 echo "== pytest (fast tier, virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q -m "not slow"
 

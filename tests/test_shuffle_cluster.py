@@ -1,0 +1,371 @@
+"""Shuffle-join exchange tests: hash partitioning, the bytes-budgeted
+fragment store, the per-bucket planner shape, and a REAL 2-worker in-process
+cluster proving a distributed equi-join executes per-bucket join fragments on
+BOTH workers with no worker receiving the full un-bucketed table.
+
+The in-process cluster runs on tiny tables (fragment programs compile in
+well under a second and the per-worker jit cache persists across tests) so
+this file stays in the fast tier — tier-1 is near its time budget; the
+large streaming / worker-death cases are marked slow.
+"""
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from igloo_tpu.catalog import MemTable
+from igloo_tpu.cluster import exchange
+from igloo_tpu.cluster.client import DistributedClient
+from igloo_tpu.cluster.coordinator import CoordinatorServer
+from igloo_tpu.cluster.worker import Worker, WorkerServer
+from igloo_tpu.engine import QueryEngine
+
+
+def _assert_same(got: pa.Table, want: pa.Table):
+    import pandas as pd
+    pd.testing.assert_frame_equal(got.to_pandas().reset_index(drop=True),
+                                  want.to_pandas().reset_index(drop=True),
+                                  check_dtype=False, atol=1e-9)
+
+
+def _tables(n=600, nc=50, seed=7):
+    rng = np.random.default_rng(seed)
+    orders = pa.table({
+        "o_id": np.arange(n, dtype=np.int64),
+        "o_cust": rng.integers(0, nc, n),
+        "o_total": np.round(rng.random(n) * 100, 2),
+    })
+    cust = pa.table({
+        "c_id": np.arange(nc, dtype=np.int64),
+        "c_name": pa.array([f"c{i:03d}" for i in range(nc)]),
+        "c_tier": pa.array([["gold", "silver"][i % 2] for i in range(nc)]),
+    })
+    return orders, cust
+
+
+# --- hash partitioning (cluster/exchange.py) --------------------------------
+
+
+def test_bucket_ids_total_and_deterministic():
+    orders, _ = _tables()
+    b1 = exchange.bucket_ids(orders, [1], 4)
+    b2 = exchange.bucket_ids(orders, [1], 4)
+    assert (b1 == b2).all()
+    assert ((b1 >= 0) & (b1 < 4)).all()
+    parts = exchange.partition_table(orders, [1], 4)
+    assert sum(p.num_rows for p in parts) == orders.num_rows
+
+
+def test_copartition_across_tables_and_dtypes():
+    """Equal key VALUES land in the same bucket regardless of which table,
+    row order, or string encoding they come from — the property that makes
+    per-bucket joins correct with no coordination."""
+    orders, cust = _tables()
+    B = 4
+    ob = exchange.bucket_ids(orders, [1], B)   # o_cust (int64)
+    cb = exchange.bucket_ids(cust, [0], B)     # c_id   (int64)
+    by_val = {int(cust.column(0)[i].as_py()): int(cb[i])
+              for i in range(cust.num_rows)}
+    for i in range(orders.num_rows):
+        v = int(orders.column(1)[i].as_py())
+        assert int(ob[i]) == by_val[v]
+    # string keys: plain vs dictionary-encoded agree
+    s = pa.table({"k": pa.array(["x", "y", "z", "x", "y"])})
+    sd = pa.table({"k": s.column(0).combine_chunks().dictionary_encode()})
+    assert (exchange.bucket_ids(s, [0], 8) ==
+            exchange.bucket_ids(sd, [0], 8)).all()
+    # nulls route consistently (and don't crash)
+    sn = pa.table({"k": pa.array([1, None, 3], type=pa.int64())})
+    assert len(exchange.bucket_ids(sn, [0], 4)) == 3
+
+
+def test_ticket_roundtrip():
+    assert exchange.parse_ticket(exchange.make_ticket("abc")) == \
+        ("abc", None, None)
+    assert exchange.parse_ticket(exchange.make_ticket("abc", 3, 8)) == \
+        ("abc", 3, 8)
+
+
+# --- FragmentStore ----------------------------------------------------------
+
+
+def test_store_bucket_slices_match_partitioning():
+    orders, _ = _tables()
+    store = exchange.FragmentStore(budget_bytes=1 << 30)
+    store.put("f1", orders, partition=([1], 4))
+    parts = exchange.partition_table(orders, [1], 4)
+    meta = store.bucket_meta("f1")
+    assert len(meta) == 4
+    for b in range(4):
+        got = store.get_table("f1", b, 4)
+        assert got.num_rows == parts[b].num_rows == meta[b]["rows"]
+        assert sorted(got.column("o_id").to_pylist()) == \
+            sorted(parts[b].column("o_id").to_pylist())
+    # whole-fragment read still serves everything
+    assert store.get_table("f1").num_rows == orders.num_rows
+    # nbuckets mismatch is an error, not a silent re-slice
+    with pytest.raises(ValueError):
+        store.get_table("f1", 0, 8)
+    store.release(["f1"])
+    assert "f1" not in store
+
+
+def test_store_budget_spills_and_streams():
+    from igloo_tpu.utils import tracing
+    n = 400_000
+    big = pa.table({"a": np.arange(n, dtype=np.int64),
+                    "b": np.arange(n, dtype=np.float64)})
+    store = exchange.FragmentStore(budget_bytes=1 << 20)  # 1 MiB floor
+    with tracing.counter_delta() as delta:
+        store.put("big", big, partition=([0], 2))
+    assert delta.get("exchange.spills") >= 1
+    # resident bytes bounded by the budget even though the result is ~6 MB
+    assert store.resident_bytes() <= store.budget_bytes
+    # spilled result streams back batch-at-a-time, bucket slices included
+    schema, it = store.stream("big")
+    batches = list(it)
+    assert len(batches) > 1
+    assert sum(b.num_rows for b in batches) == n
+    b0 = store.get_table("big", 0, 2)
+    b1 = store.get_table("big", 1, 2)
+    assert b0.num_rows + b1.num_rows == n
+    store.release(["big"])
+
+
+# --- planner shape ----------------------------------------------------------
+
+
+def _local_engine(orders, cust, partitions=1):
+    eng = QueryEngine(use_jit=False)
+    eng.register_table("orders", MemTable(orders, partitions=partitions))
+    eng.register_table("cust", MemTable(cust, partitions=partitions))
+    return eng
+
+
+JOIN_SQL = ("SELECT o.o_id, c.c_name, o.o_total FROM orders o "
+            "JOIN cust c ON o.o_cust = c.c_id ORDER BY o.o_id")
+
+
+def test_planner_emits_bucketed_join_fragments():
+    from igloo_tpu.cluster.fragment import DistributedPlanner
+    orders, cust = _tables()
+    plan = _local_engine(orders, cust, partitions=2).plan(JOIN_SQL)
+    frags = DistributedPlanner(["w1", "w2"]).plan(plan)
+    ex = [f for f in frags if f.kind == "exchange"]
+    joins = [f for f in frags if f.kind == "join"]
+    assert len(ex) == 4      # 2 partitions x 2 sides
+    assert len(joins) == 2   # one per bucket
+    assert {f.worker for f in joins} == {"w1", "w2"}
+    assert sorted(f.bucket for f in joins) == [0, 1]
+    for f in ex:
+        assert f.plan["t"] == "Exchange" and f.plan["buckets"] == 2
+    # join fragments read BUCKET slices of every side fragment
+    for f in joins:
+        refs = _frag_refs(f.plan)
+        assert len(refs) == 4
+        assert all(r.get("bucket") == f.bucket and r.get("buckets") == 2
+                   for r in refs)
+        assert set(f.deps) == {e.id for e in ex}
+    # the consumer unions the join fragments, not the scan fragments
+    root_refs = _frag_refs(frags[-1].plan)
+    assert {r["table"][len("__frag_"):] for r in root_refs} == \
+        {f.id for f in joins}
+
+
+def _frag_refs(plan_json):
+    from igloo_tpu.cluster.fragment import _frag_refs as fr
+    return fr(plan_json)
+
+
+def test_planner_shuffle_kill_switch(monkeypatch):
+    from igloo_tpu.cluster.fragment import DistributedPlanner
+    monkeypatch.setenv("IGLOO_SHUFFLE_JOIN", "0")
+    orders, cust = _tables()
+    plan = _local_engine(orders, cust, partitions=2).plan(JOIN_SQL)
+    frags = DistributedPlanner(["w1", "w2"]).plan(plan)
+    assert not any(f.kind in ("exchange", "join") for f in frags)
+
+
+def test_exchange_plan_serde_roundtrip():
+    from igloo_tpu.cluster import serde
+    from igloo_tpu.plan import logical as L
+    orders, cust = _tables()
+    eng = _local_engine(orders, cust)
+    inner = eng.plan("SELECT o_id, o_cust FROM orders")
+    ex = L.Exchange(input=inner, keys=[1], buckets=4)
+    ex.schema = inner.schema
+    j = serde.plan_to_json(ex)
+    back = serde.plan_from_json(j, eng.catalog)
+    assert isinstance(back, L.Exchange)
+    assert back.keys == [1] and back.buckets == 4
+    # bucket scan fields survive the wire
+    s = L.Scan(table="__frag_x", provider=None, bucket=2, buckets=4)
+    s.schema = inner.schema
+    s2 = serde.plan_from_json(serde.plan_to_json(s), _NullCatalog())
+    assert s2.bucket == 2 and s2.buckets == 4
+
+
+class _NullCatalog:
+    def get(self, name):
+        return None
+
+
+# --- mesh-tier skew rule ----------------------------------------------------
+
+
+def test_should_broadcast_rule():
+    from igloo_tpu.parallel.shuffle import should_broadcast
+    assert not should_broadcast(1 << 20, 1 << 20, 1)     # single device
+    assert should_broadcast(1 << 20, 1024, 8)            # small build side
+    assert not should_broadcast(1 << 10, 1 << 20, 8)     # big build side
+    # replicating the build must not move more than the probe volume
+    assert not should_broadcast(10_000, 9_000, 8)
+
+
+# --- the real 2-worker cluster ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    orders, cust = _tables()
+    coord = CoordinatorServer("grpc+tcp://127.0.0.1:0", worker_timeout_s=60.0,
+                              use_jit=True)
+    caddr = f"127.0.0.1:{coord.port}"
+    workers = [Worker(caddr, port=0, heartbeat_interval_s=0.5, use_jit=True)
+               for _ in range(2)]
+    for w in workers:
+        w.start()
+    deadline = time.time() + 20
+    while len(coord.membership.live()) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    coord.register_table("orders", MemTable(orders, partitions=2))
+    coord.register_table("cust", MemTable(cust, partitions=2))
+    local = _local_engine(orders, cust)
+    try:
+        yield {"coord": coord, "addr": caddr, "workers": workers,
+               "local": local, "orders": orders, "cust": cust}
+    finally:
+        for w in workers:
+            w.shutdown()
+        coord.shutdown()
+
+
+def test_shuffle_join_runs_on_both_workers(cluster):
+    """THE acceptance check: a 2-worker distributed equi-join executes
+    per-bucket join fragments on both workers, and no worker receives the
+    full un-bucketed table (asserted via last_metrics attribution)."""
+    client = DistributedClient(cluster["addr"])
+    got = client.execute(JOIN_SQL)
+    _assert_same(got, cluster["local"].execute(JOIN_SQL))
+    m = client.last_metrics()
+    client.close()
+    assert m["shuffle_buckets"] == 2
+    joins = [f for f in m["fragments"] if f.get("kind") == "join"]
+    exchanges = [f for f in m["fragments"] if f.get("kind") == "exchange"]
+    assert len(joins) == 2 and len(exchanges) == 4
+    # join fragments landed on BOTH workers
+    assert len({f["worker"] for f in joins}) == 2
+    # exchange fragments hash-partitioned their results
+    assert all(f.get("buckets") == 2 for f in exchanges)
+    # no join fragment saw the full input: each read only its bucket slices
+    total_in = cluster["orders"].num_rows + cluster["cust"].num_rows
+    for f in joins:
+        assert 0 < f["input_rows"] < total_in
+    # the bucket slices partition the inputs EXACTLY (each row to one bucket)
+    assert sum(f["input_rows"] for f in joins) == total_in
+    # cross-worker movement happened and was attributed
+    assert m["exchange_bytes"] > 0
+    assert any(f.get("exchange_rows", 0) > 0 for f in joins)
+
+
+def test_shuffle_join_under_aggregate(cluster):
+    sql = ("SELECT c.c_tier, SUM(o.o_total) AS rev, COUNT(*) AS n "
+           "FROM orders o JOIN cust c ON o.o_cust = c.c_id "
+           "GROUP BY c.c_tier ORDER BY c.c_tier")
+    client = DistributedClient(cluster["addr"])
+    got = client.execute(sql)
+    m = client.last_metrics()
+    client.close()
+    _assert_same(got, cluster["local"].execute(sql))
+    assert m["shuffle_buckets"] == 2
+    assert len({f["worker"] for f in m["fragments"]
+                if f.get("kind") == "join"}) == 2
+
+
+def test_semi_join_shuffles(cluster):
+    sql = ("SELECT o_id FROM orders WHERE o_cust IN "
+           "(SELECT c_id FROM cust WHERE c_tier = 'gold') ORDER BY o_id")
+    client = DistributedClient(cluster["addr"])
+    got = client.execute(sql)
+    m = client.last_metrics()
+    client.close()
+    _assert_same(got, cluster["local"].execute(sql))
+    # IN rewrites to a SEMI join — it must shuffle too
+    assert m["shuffle_buckets"] == 2
+
+
+def test_worker_metrics_include_exchange(cluster):
+    from igloo_tpu.cluster.rpc import flight_action_raw
+    client = DistributedClient(cluster["addr"])
+    client.execute(JOIN_SQL)
+    client.close()
+    text = flight_action_raw(cluster["addr"], "metrics").decode()
+    assert "igloo_coordinator_worker_exchange_bytes_total" in text
+    wtext = flight_action_raw(cluster["workers"][0].address,
+                              "metrics").decode()
+    assert "igloo_exchange_partitions_total" in wtext
+
+
+# --- streaming under the bytes budget (slow: ~100 MB table) -----------------
+
+
+@pytest.mark.slow
+def test_large_result_streams_under_budget_without_rss_double():
+    """A fragment result ~12x the store budget spills, stays bounded in
+    memory, and streams to a consumer batch-wise — peak RSS must not grow by
+    anything near the table size on either end."""
+    import resource
+
+    from igloo_tpu.cluster.rpc import flight_stream_batches
+    budget = 8 << 20
+    ws = WorkerServer("grpc+tcp://127.0.0.1:0", use_jit=False,
+                      store_budget_bytes=budget)
+    try:
+        n = 6_000_000
+        big = pa.table({"a": np.arange(n, dtype=np.int64),
+                        "b": np.arange(n, dtype=np.float64)})
+        ws._store.put("bigfrag", big)
+        assert ws._store.resident_bytes() <= budget
+        peak0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        schema, gen = flight_stream_batches(f"127.0.0.1:{ws.port}", "bigfrag")
+        rows = nb = 0
+        for batch in gen:   # consume incrementally, hold nothing
+            rows += batch.num_rows
+            nb += 1
+        peak1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        assert rows == n and nb > 10
+        assert peak1 - peak0 < big.nbytes // 2, \
+            (peak1 - peak0, big.nbytes)
+    finally:
+        ws.shutdown()
+
+
+@pytest.mark.slow
+def test_worker_death_reruns_bucket_fragments(cluster):
+    """Kill a worker that joined after table sync: per-bucket fragments are
+    pure, so the coordinator re-dispatches them and the join still answers."""
+    coord = cluster["coord"]
+    extra = Worker(cluster["addr"], port=0, heartbeat_interval_s=0.5,
+                   use_jit=False)
+    extra.start()
+    deadline = time.time() + 10
+    while len(coord.membership.live()) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(coord.membership.live()) == 3
+    extra.shutdown()  # silent death, no deregistration
+    client = DistributedClient(cluster["addr"])
+    got = client.execute(JOIN_SQL)
+    client.close()
+    _assert_same(got, cluster["local"].execute(JOIN_SQL))
+    assert all(w.addr != extra.address for w in coord.membership.live())
