@@ -46,6 +46,12 @@ BATCH_ROWS = 65536
 STORE_BUDGET_ENV = "IGLOO_FRAGMENT_STORE_BYTES"
 DEFAULT_STORE_BUDGET = 1 << 30
 
+# lock discipline (checked by igloo-lint lock-discipline): FragmentStore is
+# hit concurrently by Flight RPC threads (execute_fragment stores, do_get
+# streams, release drops) — every access to the entry map and its spill
+# bookkeeping must hold the store lock or sit in a `*_locked` method
+_GUARDED_BY = {"_lock": ("_entries", "_seq", "_tmpdir")}
+
 
 # --- deterministic key hashing ----------------------------------------------
 
@@ -269,8 +275,8 @@ class FragmentStore:
             ent = self._entries.get(frag_id)
             return list(ent.meta) if ent is not None and ent.meta else None
 
-    def _entry_range(self, frag_id: str, bucket: Optional[int],
-                     nbuckets: Optional[int]):
+    def _entry_range_locked(self, frag_id: str, bucket: Optional[int],
+                            nbuckets: Optional[int]):
         ent = self._entries.get(frag_id)
         if ent is None:
             raise KeyError(frag_id)
@@ -297,7 +303,8 @@ class FragmentStore:
         the whole stream, defeating the budget), so serving never
         re-materializes the whole result."""
         with self._lock:
-            ent, start, count = self._entry_range(frag_id, bucket, nbuckets)
+            ent, start, count = self._entry_range_locked(frag_id, bucket,
+                                                         nbuckets)
             batches = list(ent.batches) if ent.batches is not None else None
             spill = ent.spill_path
 

@@ -43,6 +43,14 @@ from igloo_tpu.plan import logical as L
 from igloo_tpu.utils import tracing
 
 
+# lock discipline (checked by igloo-lint lock-discipline): Flight serves
+# every RPC on its own thread, so two concurrent execute_fragment actions
+# race the WorkerServer's lazy mesh resolution — `_mesh`/`_mesh_setting`
+# must be read and written under the server lock (the fragment store has its
+# own internal lock, see cluster/exchange.py)
+_GUARDED_BY = {"_lock": ("_mesh", "_mesh_setting")}
+
+
 def _dep_key(frag_id: str, bucket) -> str:
     """FragmentStore key for a peer-fetched dependency slice. With
     bucket=None this is both the whole-result key and the prefix every slice
@@ -91,6 +99,7 @@ class WorkerServer(flight.FlightServerBase):
         self._store = exchange.FragmentStore(store_budget_bytes)
         self._use_jit = use_jit
         self._jit_cache: dict = {}
+        self._lock = threading.Lock()
         self._mesh_setting = mesh  # same rule as QueryEngine (resolve_mesh)
         self._mesh = None
         from igloo_tpu.exec.cache import BatchCache
@@ -101,17 +110,22 @@ class WorkerServer(flight.FlightServerBase):
     def _executor(self):
         # multi-chip worker hosts row-shard fragments across their local
         # devices; same mesh-resolution rule as QueryEngine (so tests pin
-        # DEFAULT_MESH and production configures via the constructor)
-        if self._mesh is None and self._mesh_setting is not None:
-            from igloo_tpu.parallel.mesh import resolve_mesh
-            self._mesh = resolve_mesh(self._mesh_setting)
-            if self._mesh is None:
-                self._mesh_setting = None
-        if self._mesh is not None:
+        # DEFAULT_MESH and production configures via the constructor).
+        # Lazy resolution holds the server lock: Flight runs each RPC on its
+        # own thread, and two concurrent fragments must not resolve (and
+        # assign) the mesh twice
+        with self._lock:
+            if self._mesh is None and self._mesh_setting is not None:
+                from igloo_tpu.parallel.mesh import resolve_mesh
+                self._mesh = resolve_mesh(self._mesh_setting)
+                if self._mesh is None:
+                    self._mesh_setting = None
+            mesh = self._mesh
+        if mesh is not None:
             from igloo_tpu.parallel.executor import ShardedExecutor
             return ShardedExecutor(self._jit_cache, use_jit=self._use_jit,
                                    batch_cache=self._batch_cache,
-                                   mesh=self._mesh)
+                                   mesh=mesh)
         from igloo_tpu.exec.executor import Executor
         return Executor(self._jit_cache, use_jit=self._use_jit,
                         batch_cache=self._batch_cache)

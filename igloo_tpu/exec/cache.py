@@ -13,6 +13,7 @@ crate, crates/cdc/src/lib.rs:9).
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional
@@ -149,9 +150,23 @@ class BatchCache(SnapshotLRU):
 def provider_snapshot(provider) -> object:
     """Snapshot token for a provider: changes iff the underlying data may have
     changed. Providers may implement `snapshot()` (file connectors return
-    mtimes/sizes); the fallback is provider identity, which is correct for
-    immutable in-memory tables (re-registering a table creates a new provider)."""
+    mtimes/sizes); the fallback is provider IDENTITY, correct for immutable
+    in-memory tables (re-registering a table creates a new provider).
+
+    The identity token is a weakref, not `id()`: a bare id is reused by the
+    allocator once the provider is freed, so a cache entry could validate
+    against a DIFFERENT provider that happens to land on the same address —
+    the exact staleness bug the GRACE partition loop hit (its providers now
+    carry explicit snapshot() tokens, but any other transient provider would
+    re-create it). Two live refs to the same provider compare equal; a dead
+    ref compares equal only to itself, so entries for freed providers can
+    never validate again."""
     snap = getattr(provider, "snapshot", None)
     if callable(snap):
         return snap()
-    return id(provider)
+    try:
+        return weakref.ref(provider)
+    except TypeError:
+        # non-weakrefable (slotted C extension): identity is best-effort;
+        # such providers are long-lived connector objects, not loop-allocated
+        return id(provider)  # lint: allow(cache-key)

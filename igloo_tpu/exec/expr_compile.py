@@ -68,7 +68,9 @@ class ConstPool:
 
     @classmethod
     def _padded(cls, arr: np.ndarray) -> np.ndarray:
-        key = id(arr)
+        # id() key is SAFE here: the entry pins `arr` (ent[0]) and every hit
+        # validates `ent[0] is arr`, so a recycled id can never match
+        key = id(arr)  # lint: allow(cache-key)
         ent = cls._PAD_MEMO.get(key)
         if ent is not None and ent[0] is arr:
             return ent[1]
@@ -111,14 +113,16 @@ class ConstPool:
 
     @classmethod
     def _to_device(cls, a: np.ndarray):
-        ent = cls._DEVICE_MEMO.get(id(a))
+        # id() key is SAFE here: the value tuple pins `a` and hits validate
+        # `ent[0] is a` (see the memo comment above)
+        ent = cls._DEVICE_MEMO.get(id(a))  # lint: allow(cache-key)
         if ent is not None and ent[0] is a:
             return ent[1]
         dev = jnp.asarray(a)
         if len(cls._DEVICE_MEMO) >= cls._DEVICE_MEMO_MAX:
             for k in list(cls._DEVICE_MEMO)[: cls._DEVICE_MEMO_MAX // 2]:
                 del cls._DEVICE_MEMO[k]
-        cls._DEVICE_MEMO[id(a)] = (a, dev)
+        cls._DEVICE_MEMO[id(a)] = (a, dev)  # lint: allow(cache-key)
         return dev
 
     def device_args(self) -> tuple:

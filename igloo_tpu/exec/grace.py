@@ -60,8 +60,8 @@ build sides in RAM HashMaps, crates/engine/src/operators/hash_join.rs:100-128).
 """
 from __future__ import annotations
 
-import itertools
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -407,14 +407,25 @@ def _split_by_hash(tbl: pa.Table, name: str, n_parts: int,
 
 
 # unique snapshot tokens for grace-created providers: the scan cache's
-# fallback snapshot is id(provider), and the partition loop allocates/frees
-# one provider per partition — CPython happily REUSES a freed provider's id,
-# which made the cache serve partition p-1's columns as partition p's
-_snap_ids = itertools.count()
+# fallback snapshot used to be a bare id(provider), and the partition loop
+# allocates/frees one provider per partition — CPython happily REUSES a
+# freed provider's id, which made the cache serve partition p-1's columns as
+# partition p's. Tokens come from a monotonic counter; the PREFETCH thread
+# builds _PartitionTables (each drawing a token) concurrently with
+# main-thread provider stamping, so the counter bump is lock-guarded instead
+# of leaning on itertools.count()'s accidental GIL atomicity.
+#
+# lock discipline (checked by igloo-lint lock-discipline):
+_GUARDED_BY = {"_snap_lock": ("_snap_ids",)}
+_snap_lock = threading.Lock()
+_snap_ids = 0
 
 
 def _fresh_snapshot() -> str:
-    return f"__grace_snap_{next(_snap_ids)}"
+    global _snap_ids
+    with _snap_lock:
+        _snap_ids += 1
+        return f"__grace_snap_{_snap_ids}"
 
 
 def _stamp_snapshot(provider) -> object:

@@ -1,0 +1,168 @@
+"""igloo-lint: AST-based hazard analysis for the engine's own bug classes.
+
+The reference gates every change behind ``clippy -D warnings`` — a semantic
+linter that knows Rust's hazard classes (Send/Sync, borrow discipline). Ruff
+gives us style, but none of the bug classes this codebase has actually
+shipped were machine-checked: PR 2 fixed an ``id()``-reuse cache-staleness
+bug by hand, PR 4 added a second threaded subsystem whose lock discipline is
+enforced only by convention, and the whole perf story depends on implicit
+host<->device syncs staying out of the hot path. This package is the
+counterpart: one shared AST walk over ``igloo_tpu/`` with per-checker
+visitors (docs/static_analysis.md has the rule catalog):
+
+- ``sync-hazard``     implicit device syncs (bool/int/float/len/.item()/
+                      np.asarray/iteration/device_get on jax-originating
+                      values) in the hot-path modules (exec/, parallel/)
+                      outside the documented choke-point whitelist;
+- ``cache-key``       identity (``id()``) tokens, ``hash()`` over mutable
+                      state, and dict/set iteration order feeding cache or
+                      jit keys — the PR-2 staleness bug class;
+- ``lock-discipline`` every access to state a module declares via
+                      ``_GUARDED_BY`` must hold the declared lock (or sit in
+                      a caller-locked method);
+- ``metric-names``    tracing counter/histogram names must match the catalog
+                      in docs/observability.md (migrated from
+                      scripts/check_metrics_names.py).
+
+Suppress a finding with a trailing ``# lint: allow(<rule>)`` comment on the
+offending line (or a standalone allow-comment on the line directly above);
+every suppression should say why on the same line or the surrounding code.
+
+Entry point: ``python -m igloo_tpu.lint`` (wired into scripts/validate.sh
+and the __graft_entry__ dryrun preamble). Pure AST — no imports of the
+checked code, so it runs in a couple of seconds with no device/backend.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent   # igloo_tpu/
+REPO_ROOT = PACKAGE_ROOT.parent
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class LintModule:
+    """One parsed source file, shared by every checker."""
+    path: Path
+    relpath: str                        # repo-relative, forward slashes
+    text: str
+    tree: ast.Module
+    # line -> set of rule names allowed on that line (an allow-comment on its
+    # own line also covers the line below, for statements too long to share)
+    allows: dict = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: Path, root: Path = REPO_ROOT) -> "LintModule":
+        path = Path(path).resolve()
+        text = path.read_text()
+        tree = ast.parse(text, filename=str(path))
+        allows: dict[int, set] = {}
+        for i, line in enumerate(text.splitlines(), start=1):
+            m = _ALLOW_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            allows.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):   # standalone comment line
+                allows.setdefault(i + 1, set()).update(rules)
+        try:
+            rel = path.relative_to(Path(root).resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()  # outside the root: report the full path
+        return cls(path=path, relpath=rel, text=text, tree=tree,
+                   allows=allows)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        return rule in self.allows.get(line, ())
+
+
+class Checker:
+    """One rule family. Subclasses set `name` and implement `check`;
+    checkers needing repo-level context (docs files) override `finalize`,
+    which runs once after every module has been checked."""
+
+    name = "checker"
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, modules: list) -> Iterable[Finding]:
+        return ()
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jnp.sum' / 'jax.lax.scan' / 'self._lock' for Name/Attribute chains;
+    None for anything else (calls, subscripts)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_package_files(root: Path = PACKAGE_ROOT) -> list[Path]:
+    """Every package source file except lint/ itself (the linter's own regex
+    literals and rule tables would self-match)."""
+    lint_dir = root / "lint"
+    return sorted(p for p in root.rglob("*.py")
+                  if "__pycache__" not in p.parts
+                  and lint_dir not in p.parents)
+
+
+def default_checkers() -> list:
+    from igloo_tpu.lint.cache_key import CacheKeyChecker
+    from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
+    from igloo_tpu.lint.metric_names import MetricNamesChecker
+    from igloo_tpu.lint.sync_hazard import SyncHazardChecker
+    return [SyncHazardChecker(), CacheKeyChecker(),
+            LockDisciplineChecker(), MetricNamesChecker()]
+
+
+def run_lint(paths: Optional[list] = None, checkers: Optional[list] = None,
+             select: Optional[set] = None, root: Path = REPO_ROOT
+             ) -> tuple[list, list]:
+    """-> (findings, warnings). `paths` defaults to the igloo_tpu package
+    (lint/ itself excluded); `select` restricts to a subset of rule names."""
+    if checkers is None:
+        checkers = default_checkers()
+    if select:
+        checkers = [c for c in checkers if c.name in select]
+    files = paths if paths is not None else iter_package_files()
+    modules = [LintModule.parse(Path(p), root=root) for p in files]
+    findings: list[Finding] = []
+    warnings: list[str] = []
+    by_path = {m.relpath: m for m in modules}
+    for c in checkers:
+        got: list[Finding] = []
+        for mod in modules:
+            for f in c.check(mod):
+                if not mod.allowed(f.rule, f.line):
+                    got.append(f)
+        for f in c.finalize(modules):
+            m = by_path.get(f.path)
+            if m is None or not m.allowed(f.rule, f.line):
+                got.append(f)
+        warnings.extend(getattr(c, "warnings", ()))
+        findings.extend(got)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, warnings

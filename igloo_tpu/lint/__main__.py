@@ -1,0 +1,83 @@
+"""`python -m igloo_tpu.lint` — run the hazard checkers over the package.
+
+Exit 0 when clean, 1 on findings, 2 on usage errors. Pure AST: no engine
+imports, no jax backend init, so the whole run takes a couple of seconds
+(scripts/validate.sh and __graft_entry__.py's dryrun preamble gate on it).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    from igloo_tpu.lint import (
+        default_checkers, iter_package_files, run_lint,
+    )
+    ap = argparse.ArgumentParser(prog="python -m igloo_tpu.lint")
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: the igloo_tpu package)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule names to run "
+                         "(default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress warnings and the OK summary")
+    args = ap.parse_args(argv)
+
+    checkers = default_checkers()
+    if args.list_rules:
+        for c in checkers:
+            doc = (sys.modules[type(c).__module__].__doc__ or "").strip()
+            head = doc.splitlines()[0] if doc else ""
+            print(f"{c.name}: {head}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        known = {c.name for c in checkers}
+        bad = select - known
+        if bad:
+            print(f"igloo-lint: unknown rule(s): {', '.join(sorted(bad))} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+
+    paths = None
+    if args.paths:
+        paths = []
+        for raw in args.paths:
+            p = Path(raw).resolve()   # relative args must map into the repo
+            if not p.exists():
+                print(f"igloo-lint: no such file: {raw}", file=sys.stderr)
+                return 2
+            if p.is_dir():
+                paths.extend(sorted(q for q in p.rglob("*.py")
+                                    if "__pycache__" not in q.parts))
+            else:
+                paths.append(p)
+
+    t0 = time.perf_counter()
+    findings, warnings = run_lint(paths=paths, checkers=checkers,
+                                  select=select)
+    if not args.quiet:
+        for w in warnings:
+            print(f"warning: {w}", file=sys.stderr)
+    if findings:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"igloo-lint: {n} finding{'s' if n != 1 else ''} "
+              f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        nfiles = len(paths) if paths else len(iter_package_files())
+        print(f"igloo-lint: OK ({nfiles} files, "
+              f"{time.perf_counter() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""cache-key: identity tokens, mutable hashes and unordered iteration in keys.
+
+PR 2 shipped (and fixed) this exact bug class: the scan cache's fallback
+snapshot token was ``id(provider)``, the GRACE loop frees and reallocates one
+provider per partition, CPython reuses a freed object's id, and the cache
+served partition p-1's columns as partition p's. Nothing about ``id()`` in a
+key LOOKS wrong at the call site — which makes it a linter's job:
+
+- ``id(...)`` feeding a key: flagged when the result is assigned to a
+  key-ish name (``key``/``snap``/``fp``/``token``...), returned from a
+  function named like a token factory (``*snapshot*``/``*_key``/
+  ``*fingerprint*``), used to index / ``.get()`` / ``.setdefault()`` a
+  cache-ish mapping (name contains ``cache``/``memo``/``_entries``/
+  ``registry``), or placed in a tuple bound to a key-ish name. Plan-identity
+  maps scoped to one planning pass (``leaf_ids[id(node)]``) are fine and not
+  matched. An ``id()`` key is only sound when the keyed object is itself
+  kept alive by the entry AND validated with an ``is`` check on hit — that
+  idiom must carry a ``# lint: allow(cache-key)`` with the rationale.
+- ``hash()`` over mutable state: ``hash([...])``-style calls over
+  list/set/dict displays or names locally bound to them, and ``__hash__``
+  methods reading attributes that ``__init__`` binds to mutable containers
+  (a dict key that can change its hash after insertion is a time bomb).
+- unordered iteration feeding a key: set displays/comprehensions and
+  ``.keys()``/``.values()``/``.items()`` iteration inside expressions bound
+  to key-ish names or passed to ``*_jitted(...)`` — two processes (or two
+  runs) would disagree on the key. Sort it or use a deterministic order.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from igloo_tpu.lint import Checker, Finding, LintModule, dotted
+
+RULE = "cache-key"
+
+_KEYISH_NAME = re.compile(
+    r"(^|_)(key|keys|snap|snapshot|fp|fps|fingerprint|token|tok|jfp|hkey|"
+    r"fpbase|sig|signature)($|_)|^(fp|jfp|hkey|snap)[0-9]*$")
+_TOKEN_FN = re.compile(r"snapshot|fingerprint|_key$|^key|token")
+_CACHEISH = re.compile(r"cache|memo|_entries|registry|seen|snapshots")
+_MUTABLE_DISPLAYS = (ast.List, ast.Set, ast.Dict, ast.ListComp, ast.SetComp,
+                     ast.DictComp)
+
+
+def _keyish(name: Optional[str]) -> bool:
+    return name is not None and bool(_KEYISH_NAME.search(name.split(".")[-1]))
+
+
+def _cacheish(name: Optional[str]) -> bool:
+    return name is not None and bool(_CACHEISH.search(name.lower()))
+
+
+def _contains(node: ast.AST, pred) -> Optional[ast.AST]:
+    for sub in ast.walk(node):
+        if pred(sub):
+            return sub
+    return None
+
+
+def _is_id_call(n: ast.AST) -> bool:
+    return isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and \
+        n.func.id == "id"
+
+
+def _is_unordered_iter(n: ast.AST) -> bool:
+    """set display/comprehension, or iteration over dict .keys/.values/.items
+    (plain dict order is insertion order — stable in one process but not
+    across processes when the inserts themselves vary)."""
+    if isinstance(n, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) and \
+            n.func.attr in ("keys", "values", "items") and not n.args:
+        return True
+    return False
+
+
+def _unsorted(n: ast.AST) -> Optional[ast.AST]:
+    """An unordered source not wrapped in sorted(...) anywhere below."""
+    for sub in ast.walk(n):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) and \
+                sub.func.id in ("sorted", "frozenset", "set", "len", "sum",
+                                "min", "max"):
+            continue
+        if _is_unordered_iter(sub) and not _wrapped_sorted(n, sub):
+            return sub
+    return None
+
+
+def _wrapped_sorted(root: ast.AST, target: ast.AST) -> bool:
+    """True when `target` sits inside a sorted()/frozenset()/aggregate call
+    (order-insensitive consumption) somewhere under `root`."""
+    order_free = ("sorted", "frozenset", "set", "len", "sum", "min", "max",
+                  "any", "all")
+    for sub in ast.walk(root):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) and \
+                sub.func.id in order_free:
+            for inner in ast.walk(sub):
+                if inner is target:
+                    return True
+    return False
+
+
+class CacheKeyChecker(Checker):
+    name = RULE
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        out: list[Finding] = []
+        tree = mod.tree
+
+        def report(node: ast.AST, msg: str) -> None:
+            out.append(Finding(RULE, mod.relpath, node.lineno, msg))
+
+        for node in ast.walk(tree):
+            # --- id() into key-ish bindings / cache-ish lookups ----------
+            if isinstance(node, ast.Assign):
+                idc = _contains(node.value, _is_id_call)
+                if idc is not None and any(
+                        _keyish(dotted(t)) for t in node.targets):
+                    report(idc, "id() bound to a key-ish name: ids are "
+                           "reused after free (the PR-2 staleness bug); use "
+                           "a snapshot()/monotonic token, or pin the object "
+                           "and validate with `is` (then allow-comment it)")
+                src = _unsorted(node.value) if any(
+                    _keyish(dotted(t)) for t in node.targets) else None
+                if src is not None:
+                    report(src, "unordered iteration feeding a key-ish "
+                           "binding: dict/set order is not deterministic "
+                           "across processes; sort it")
+            elif isinstance(node, ast.Return) and node.value is not None:
+                pass  # handled via function scan below
+            elif isinstance(node, ast.Subscript):
+                if _cacheish(dotted(node.value)) and \
+                        _contains(node.slice, _is_id_call):
+                    report(node, "id() used as a cache/memo subscript key; "
+                           "entries outlive the object and ids get reused — "
+                           "pin + `is`-validate (and allow-comment) or use a "
+                           "real token")
+            elif isinstance(node, ast.Call):
+                fname = dotted(node.func)
+                if fname is not None and fname.split(".")[-1] in (
+                        "get", "setdefault", "pop") and \
+                        isinstance(node.func, ast.Attribute) and \
+                        _cacheish(dotted(node.func.value)) and node.args and \
+                        _contains(node.args[0], _is_id_call):
+                    report(node, "id() used as a cache/memo lookup key "
+                           "(see PR-2 staleness class); pin + `is`-validate "
+                           "or use a real token")
+                elif fname is not None and fname.split(".")[-1] == "_jitted" \
+                        and node.args:
+                    src = _unsorted(node.args[1]) if len(node.args) > 1 \
+                        else None
+                    if src is not None:
+                        report(src, "unordered iteration inside a jit-cache "
+                               "fingerprint; sort it")
+                # hash() over visibly-mutable argument
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id == "hash" and node.args and \
+                        isinstance(node.args[0], _MUTABLE_DISPLAYS):
+                    report(node, "hash() over a mutable container display; "
+                           "hash a tuple/frozenset of immutables instead")
+
+        # --- token-factory returns + mutable __hash__ ---------------------
+        for fn, cls in _functions_with_class(tree):
+            if _TOKEN_FN.search(fn.name) and fn.name != "__hash__":
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Return) and sub.value is not None:
+                        idc = _contains(sub.value, _is_id_call)
+                        if idc is not None:
+                            out.append(Finding(
+                                RULE, mod.relpath, idc.lineno,
+                                f"`{fn.name}` returns an id()-based token: "
+                                "a freed object's id is reused, so the "
+                                "token can validate stale state (PR-2 bug "
+                                "class); return a weakref/monotonic token"))
+            if fn.name == "__hash__" and cls is not None:
+                for attr in _mutable_attrs_of(cls) & _attrs_read(fn):
+                    out.append(Finding(
+                        RULE, mod.relpath, fn.lineno,
+                        f"__hash__ of `{cls.name}` reads `self.{attr}`, "
+                        "which __init__ binds to a mutable container — the "
+                        "hash can change after the object is used as a key; "
+                        "store an immutable copy (tuple) instead"))
+        return out
+
+
+def _functions_with_class(tree: ast.Module):
+    """(function node, enclosing ClassDef | None), each function ONCE —
+    ast.walk reaches a method both via its class and as a plain FunctionDef,
+    so the method set is collected first and skipped on the second pass."""
+    methods = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.append((sub, node))
+    seen = {id(fn) for fn, _ in methods}
+    yield from methods
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                id(node) not in seen:
+            yield node, None
+
+
+def _mutable_attrs_of(cls: ast.ClassDef) -> set:
+    """self.X names that __init__ binds to list/dict/set displays or
+    list()/dict()/set() calls."""
+    out: set = set()
+    for sub in cls.body:
+        if isinstance(sub, ast.FunctionDef) and sub.name == "__init__":
+            for node in ast.walk(sub):
+                if not isinstance(node, ast.Assign):
+                    continue
+                v = node.value
+                mutable = isinstance(v, _MUTABLE_DISPLAYS) or (
+                    isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                    and v.func.id in ("list", "dict", "set"))
+                if not mutable:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        out.add(t.attr)
+    return out
+
+
+def _attrs_read(fn: ast.AST) -> set:
+    return {n.attr for n in ast.walk(fn)
+            if isinstance(n, ast.Attribute) and
+            isinstance(n.value, ast.Name) and n.value.id == "self"}

@@ -1,0 +1,188 @@
+"""lock-discipline: declared guarded state must be accessed under its lock.
+
+The threaded subsystems (FragmentStore, the metrics registry, the GRACE
+prefetch pipeline, the worker's Flight RPC threads) guard shared state with
+locks whose discipline was previously enforced only by convention — nothing
+stopped a new method from reading ``self._entries`` without ``self._lock``.
+
+A module opts in by declaring its guarded state at module level:
+
+    _GUARDED_BY = {"_lock": ("_entries", "_seq"), "_delta_lock": ("_data",)}
+
+Keys are lock names — matched as ``self.<lock>`` (instance locks) or a bare
+module-global name; values are the attribute/global names they guard. The
+checker then requires every load/store of a guarded name anywhere in the
+module (any receiver — aliases like ``ent._entries`` are deliberately
+caught) to be one of:
+
+- lexically inside ``with self.<lock>:`` / ``with <lock>:`` (any of the
+  with-items; ``.acquire()`` calls don't count — use ``with``);
+- in a method whose name ends in ``_locked`` (the caller-holds-the-lock
+  naming convention), or whose docstring contains ``caller-locked``;
+- in ``__init__``/``__new__`` (the object is not shared yet) or at module
+  scope (import-time init, serialized by the import lock);
+- suppressed with ``# lint: allow(lock-discipline)``.
+
+Declared locks or guarded names that never appear in the module are
+warnings (stale declaration).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from igloo_tpu.lint import Checker, Finding, LintModule, dotted
+
+RULE = "lock-discipline"
+
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+def _load_guarded_by(tree: ast.Module) -> Optional[dict]:
+    """Evaluate the module-level `_GUARDED_BY = {...}` literal, if any."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "_GUARDED_BY":
+                    try:
+                        v = ast.literal_eval(node.value)
+                    except ValueError:
+                        return None
+                    if isinstance(v, dict):
+                        return {str(k): tuple(vs) for k, vs in v.items()}
+    return None
+
+
+class _Access:
+    __slots__ = ("name", "line", "kind")
+
+    def __init__(self, name: str, line: int, kind: str):
+        self.name, self.line, self.kind = name, line, kind
+
+
+class _ModulePass(ast.NodeVisitor):
+    """Collect guarded-name accesses with their lock/function context."""
+
+    def __init__(self, guards: dict):
+        self.guards = guards                      # lock -> guarded names
+        self.guarded: dict = {}                   # name -> lock
+        for lock, names in guards.items():
+            for n in names:
+                self.guarded[n] = lock
+        self.held: list[str] = []                 # lock-name stack
+        self.fn_stack: list[ast.AST] = []
+        self.violations: list[_Access] = []
+        self.seen_names: set = set()
+        self.seen_locks: set = set()
+
+    # --- context helpers ---
+
+    def _fn_exempt(self) -> bool:
+        for fn in reversed(self.fn_stack):
+            name = getattr(fn, "name", "")
+            if name in _EXEMPT_METHODS or name.endswith("_locked"):
+                return True
+            doc = ast.get_docstring(fn) if isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+            if doc and "caller-locked" in doc.lower():
+                return True
+        return False
+
+    def _lock_of(self, item: ast.AST) -> Optional[str]:
+        """'_lock' for `self._lock` / `cls._lock` / bare `_lock` with-items."""
+        name = dotted(item)
+        if name is None:
+            return None
+        parts = name.split(".")
+        cand = parts[-1]
+        if cand in self.guards and (len(parts) == 1 or
+                                    parts[0] in ("self", "cls")):
+            return cand
+        return None
+
+    # --- visitors ---
+
+    def visit_With(self, node: ast.With) -> None:
+        got = [lk for item in node.items
+               if (lk := self._lock_of(item.context_expr)) is not None]
+        self.held.extend(got)
+        self.seen_locks.update(got)
+        self.generic_visit(node)
+        for _ in got:
+            self.held.pop()
+
+    def _visit_fn(self, node) -> None:
+        # a `with self._lock:` held OUTSIDE a nested def is NOT held when the
+        # def later runs (closures escape) — reset the held stack inside
+        self.fn_stack.append(node)
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+    visit_Lambda = _visit_fn
+
+    def _record(self, name: str, line: int, kind: str) -> None:
+        self.seen_names.add(name)
+        lock = self.guarded[name]
+        if lock in self.held or self._fn_exempt():
+            return
+        if not self.fn_stack:
+            return  # module scope: import-time init, serialized by the
+            #         import lock before any thread can share the state
+        self.violations.append(_Access(name, line, kind))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in self.guarded:
+            kind = "write" if isinstance(node.ctx,
+                                         (ast.Store, ast.Del)) else "read"
+            self._record(node.attr, node.lineno, kind)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        # module-global guarded names (e.g. a counter next to a module lock)
+        if node.id in self.guarded:
+            kind = "write" if isinstance(node.ctx,
+                                         (ast.Store, ast.Del)) else "read"
+            self._record(node.id, node.lineno, kind)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        return  # `global x` declarations are not accesses
+
+
+class LockDisciplineChecker(Checker):
+    name = RULE
+
+    def __init__(self):
+        self.warnings: list[str] = []
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        guards = _load_guarded_by(mod.tree)
+        if guards is None:
+            return ()
+        p = _ModulePass(guards)
+        # skip the _GUARDED_BY assignment itself
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "_GUARDED_BY"
+                    for t in node.targets):
+                continue
+            p.visit(node)
+        for lock, names in guards.items():
+            if lock not in p.seen_locks:
+                self.warnings.append(
+                    f"lock-discipline: {mod.relpath}: declared lock "
+                    f"`{lock}` never appears in a `with` block")
+            for n in names:
+                if n not in p.seen_names:
+                    self.warnings.append(
+                        f"lock-discipline: {mod.relpath}: guarded name "
+                        f"`{n}` never accessed — stale declaration?")
+        return [Finding(
+            RULE, mod.relpath, a.line,
+            f"{a.kind} of `{a.name}` (guarded by `{p.guarded[a.name]}` per "
+            "_GUARDED_BY) outside a `with` block holding the lock; hold the "
+            "lock, rename the method `*_locked`, or document it caller-locked")
+            for a in p.violations]

@@ -1,0 +1,155 @@
+"""metric-names: tracing counter/histogram names must match the catalog.
+
+Migrated from scripts/check_metrics_names.py into the shared lint framework
+(same rules, same catalog): every ``tracing.counter(...)`` /
+``tracing.histogram(...)`` name used in the package must be covered by the
+catalog in docs/observability.md, so metric names cannot silently drift or
+typo-fork (``pack.hits`` vs ``pack.hit``).
+
+Rules:
+- a literal name must be covered by the catalog verbatim (or by a
+  documented ``prefix.*`` wildcard);
+- an f-string name is reduced to its literal prefix (up to the first ``{``,
+  trailing dot stripped) which must be covered by a ``prefix.*`` wildcard;
+- a name with NO literal prefix (e.g. ``f"{self.counter_prefix}.hit"``)
+  must resolve through DYNAMIC_PREFIXES below, each expansion documented.
+
+Catalog entries no code uses are warnings only (some call sites are
+platform-gated).
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Iterable, Optional
+
+from igloo_tpu.lint import REPO_ROOT, Checker, Finding, LintModule
+
+RULE = "metric-names"
+
+# placeholder -> the values it takes across the codebase (SnapshotLRU
+# subclasses set counter_prefix)
+DYNAMIC_PREFIXES = {
+    "self.counter_prefix": ["cache", "result_cache"],
+}
+
+CALL_RE = re.compile(
+    r"(?:tracing\.)?(?:counter|histogram)\(\s*(f?)[\"']", re.MULTILINE)
+# metric-name string literals inside one call region (covers ternary arms:
+# counter("a" if ok else "b"))
+NAME_STR_RE = re.compile(
+    r"[\"']([a-z][a-z0-9_]*(?:\.[a-z0-9_{}.]+)*|\{[a-zA-Z_.]+\}[a-z0-9_.]*)"
+    r"[\"']")
+DOC_NAME_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_*.]+)+)`")
+
+
+def _covered(name: str, catalog: set) -> bool:
+    if name in catalog:
+        return True
+    parts = name.split(".")
+    return any(".".join(parts[:i]) + ".*" in catalog
+               for i in range(len(parts) - 1, 0, -1))
+
+
+class MetricNamesChecker(Checker):
+    name = RULE
+
+    #: overridable for fixture tests (None -> docs/observability.md)
+    doc_path: Optional[Path] = None
+    dynamic_prefixes = DYNAMIC_PREFIXES
+
+    def __init__(self, doc_path: Optional[Path] = None):
+        if doc_path is not None:
+            self.doc_path = Path(doc_path)
+        self.sites: list[tuple] = []       # (name, is_fstring, path, line)
+        self.warnings: list[str] = []
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        text = mod.text
+        for m in CALL_RE.finditer(text):
+            line = text[: m.start()].count("\n") + 1
+            region = text[m.start():]
+            # the call's argument region: up to the first close-paren at
+            # line end (good enough for this codebase's formatting)
+            end = region.find(")\n")
+            region = region[: end + 1 if end >= 0 else 240]
+            is_f = m.group(1) == "f" or ', f"' in region or " f\"" in region
+            for nm in NAME_STR_RE.findall(region):
+                if "." not in nm and "{" not in nm:
+                    continue  # not a metric-shaped string (e.g. format arg)
+                self.sites.append((nm, is_f or "{" in nm,
+                                   mod.relpath, line))
+        return ()
+
+    def _doc(self) -> Path:
+        return self.doc_path if self.doc_path is not None \
+            else REPO_ROOT / "docs" / "observability.md"
+
+    def _catalog(self) -> Optional[set]:
+        doc = self._doc()
+        if not doc.exists():
+            return None
+        text = doc.read_text()
+        start = text.find("## Metrics catalog")
+        end = text.find("## Per-query", start)
+        section = text[start:end] if start >= 0 else text
+        return set(DOC_NAME_RE.findall(section))
+
+    def finalize(self, modules: list) -> Iterable[Finding]:
+        catalog = self._catalog()
+        if catalog is None:
+            return [Finding(RULE, "docs/observability.md", 1,
+                            "metrics catalog file is missing")]
+        out: list[Finding] = []
+        used_plain: set = set()
+        for nm, is_f, path, line in self.sites:
+            if not is_f:
+                used_plain.add(nm)
+                if not _covered(nm, catalog):
+                    out.append(Finding(
+                        RULE, path, line, f"metric `{nm}` is not documented "
+                        "in docs/observability.md"))
+                continue
+            if nm.startswith("{"):
+                ph = nm[1:].split("}", 1)[0]
+                suffix = nm.split("}", 1)[1].lstrip(".") if "}" in nm else ""
+                expansions = self.dynamic_prefixes.get(ph)
+                if expansions is None:
+                    out.append(Finding(
+                        RULE, path, line, f"fully dynamic metric name "
+                        f"`{nm}` is not in DYNAMIC_PREFIXES "
+                        "(igloo_tpu/lint/metric_names.py)"))
+                    continue
+                for p in expansions:
+                    full = f"{p}.{suffix}" if suffix else p
+                    used_plain.add(full)
+                    if not _covered(full, catalog):
+                        out.append(Finding(
+                            RULE, path, line, f"metric `{full}` "
+                            "(dynamic-prefix expansion) is undocumented"))
+                continue
+            prefix = nm.split("{", 1)[0].rstrip(".")
+            used_plain.add(prefix + ".dynamic")
+            if not _covered(prefix + ".dynamic", catalog):
+                out.append(Finding(
+                    RULE, path, line, f"f-string metric `{nm}` needs a "
+                    f"`{prefix}.*` wildcard in the catalog"))
+        # unused-entry warnings only make sense when the WHOLE package was
+        # scanned — on a partial run (explicit paths) nearly every entry
+        # would look stale and drown real warnings
+        from igloo_tpu.lint import REPO_ROOT as _root
+        from igloo_tpu.lint import iter_package_files
+        linted = {m.relpath for m in modules}
+        pkg = {p.resolve().relative_to(_root.resolve()).as_posix()
+               for p in iter_package_files()}
+        if pkg and pkg <= linted:
+            for entry in sorted(catalog):
+                base = entry[:-2] if entry.endswith(".*") else entry
+                hit = any(u == base or u.startswith(base + ".")
+                          for u in used_plain) if entry.endswith(".*") \
+                    else base in used_plain
+                if not hit:
+                    self.warnings.append(
+                        f"metric-names: catalog entry `{entry}` matches no "
+                        "code call site")
+        return out

@@ -1,0 +1,306 @@
+"""sync-hazard: implicit host<->device syncs in the hot-path modules.
+
+On a tunneled TPU a device->host readback costs ~100-300 ms of pure RTT
+(BASELINE.md), so the engine's whole perf story depends on syncs happening
+only at a handful of documented choke points (the final result fetch, the
+first-sight cardinality sync, the codec canary). A sync is easy to add by
+accident: ``bool()``/``int()``/``float()`` on a jax array, ``.item()``,
+``np.asarray`` over a device value, iterating a device array, or an ``if``
+over one — none of them LOOK like transfers.
+
+This checker runs a per-function, dataflow-local taint pass over the hot
+modules (``exec/``, ``parallel/``):
+
+- taint sources: calls through ``jnp.*`` / ``jax.lax.*`` / ``jax.nn.*`` /
+  ``jax.device_put`` / ``jax.jit(...)``'s result, and calls of names locally
+  bound to ``self._jitted(...)`` or ``jax.jit(...)`` (the executor idiom:
+  ``fn = self._jitted(...); out = fn(...)``). Attribute loads and
+  subscripts of tainted values are tainted; ``jax.device_get`` output is
+  host data and UNTAINTS its targets.
+- sync sinks on tainted values: ``bool/int/float/len/np.asarray/np.array``,
+  ``.item()``/``.tolist()``, ``for``-iteration, truth tests (``if``/
+  ``while``/``assert``/conditional expressions). Calls to ``.num_live()``
+  and ``jax.device_get``/``.block_until_ready()`` are sync sites
+  unconditionally — they exist to sync.
+
+Findings are errors unless the enclosing function is a documented choke
+point in ``CHOKE_POINTS`` below (each entry carries its rationale; the
+whitelist is rendered in docs/static_analysis.md) or carries a
+``# lint: allow(sync-hazard)`` suppression. Whitelist entries that match no
+function are reported as warnings so the list cannot go stale.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from igloo_tpu.lint import Checker, Finding, LintModule, dotted
+
+RULE = "sync-hazard"
+
+# modules (repo-relative prefixes) where implicit syncs are hazards
+HOT_PREFIXES = ("igloo_tpu/exec/", "igloo_tpu/parallel/")
+
+# (repo-relative path, function qualname) -> rationale. These are the
+# engine's DOCUMENTED sync choke points: each either is the single
+# result-fetch round trip a query must pay, or trades one scalar readback
+# for a compile/shape decision that cannot be made on device.
+CHOKE_POINTS = {
+    ("igloo_tpu/exec/batch.py", "DeviceBatch.num_live"):
+        "THE count-sync primitive: one int readback, every caller below "
+        "budgets it explicitly.",
+    ("igloo_tpu/exec/batch.py", "to_arrow"):
+        "the result fetch: one device_get for every buffer of the final "
+        "batch (one round trip instead of one per column).",
+    ("igloo_tpu/exec/executor.py", "Executor.execute"):
+        "deferred speculative-flag fetch: flags accumulated across the "
+        "query come back in one readback at the end.",
+    ("igloo_tpu/exec/executor.py", "Executor._fused_run"):
+        "the fused path's single fetch: result + flags + cardinality "
+        "stats in one device_get (the whole point of fusion).",
+    ("igloo_tpu/exec/executor.py", "Executor._staged_to_arrow"):
+        "final fetch of the staged path (speculative compact + one "
+        "device_get; overflow pays an exact refetch).",
+    ("igloo_tpu/exec/executor.py", "Executor._exec"):
+        "EXPLAIN ANALYZE detail mode only: per-operator actual row "
+        "counts are the product being sold, one num_live sync each.",
+    ("igloo_tpu/exec/executor.py", "Executor._exec_join"):
+        "non-speculative joins must size the expand capacity: one "
+        "candidate-total readback (int(p.total)) per join.",
+    ("igloo_tpu/exec/executor.py", "Executor._adaptive_input"):
+        "first sight of a subtree costs one live-count sync to seed the "
+        "persistent capacity hint; later runs are sync-free.",
+    ("igloo_tpu/exec/executor.py", "Executor._maybe_shrink"):
+        "capacity shrink between stages: one live-count sync, skipped "
+        "entirely under _SYNC_FREE_CAPACITY or a known count.",
+    ("igloo_tpu/exec/codec.py", "_scaled_decimal_ok"):
+        "one-time per-process canary: replays the scaled-decimal divide "
+        "on device before trusting it (round-5 advisor item).",
+}
+
+_SOURCE_PREFIXES = ("jnp.", "jax.lax.", "jax.nn.", "jax.numpy.")
+_SOURCE_EXACT = {"jax.device_put"}
+# metadata predicates/queries that return HOST values despite the jnp prefix
+_HOST_META = {"issubdtype", "iinfo", "finfo", "dtype", "result_type",
+              "promote_types", "shape", "ndim", "isdtype"}
+_JIT_MAKERS = {"jax.jit"}          # plus any `self._jitted` / `cls._jitted`
+_UNTAINT_CALLS = {"jax.device_get"}
+_CAST_SINKS = {"bool", "int", "float", "len"}
+_NP_SINKS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_METHOD_SINKS = {"item", "tolist"}
+_SYNC_CALLS = {"num_live", "block_until_ready"}  # sync by definition
+
+
+def _is_source_call(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    if name is None:
+        return False
+    if name.split(".")[-1] in _HOST_META:
+        return False
+    return name in _SOURCE_EXACT or \
+        any(name.startswith(p) for p in _SOURCE_PREFIXES)
+
+
+class _FunctionPass(ast.NodeVisitor):
+    """Taint pass over ONE function body (nested defs get their own pass)."""
+
+    def __init__(self, checker: "SyncHazardChecker", mod: LintModule,
+                 qualname: str, fn: ast.AST):
+        self.checker = checker
+        self.mod = mod
+        self.qualname = qualname
+        self.fn = fn
+        self.tainted: set[str] = set()
+        self.jit_fns: set[str] = set()   # names bound to jax.jit/self._jitted
+
+    # --- taint bookkeeping ---
+
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            return self._expr_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            if _is_source_call(node):
+                return True
+            name = dotted(node.func)
+            if name is not None:
+                if name in _UNTAINT_CALLS:
+                    return False
+                if name in self.jit_fns:
+                    return True
+                # immediately-invoked jit builder: self._jitted(...)(args)
+            if isinstance(node.func, ast.Call):
+                inner = dotted(node.func.func)
+                if inner is not None and self._is_jit_maker(inner):
+                    return True
+            return False
+        # NOTE: list/tuple displays deliberately do NOT propagate taint —
+        # a host list OF device arrays is host data (len()/iteration over it
+        # never touch the device)
+        if isinstance(node, ast.IfExp):
+            return self._expr_tainted(node.body) or \
+                self._expr_tainted(node.orelse)
+        if isinstance(node, ast.BinOp):
+            return self._expr_tainted(node.left) or \
+                self._expr_tainted(node.right)
+        if isinstance(node, (ast.UnaryOp,)):
+            return self._expr_tainted(node.operand)
+        return False
+
+    @staticmethod
+    def _is_jit_maker(name: str) -> bool:
+        return name in _JIT_MAKERS or name.endswith("._jitted")
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.tainted.add if tainted
+             else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+        # attribute/subscript stores don't track
+
+    # --- findings ---
+
+    def _report(self, node: ast.AST, what: str) -> None:
+        key = (self.mod.relpath, self.qualname)
+        if key in CHOKE_POINTS:
+            self.checker.used_choke_points.add(key)
+            return
+        self.checker.out.append(Finding(
+            RULE, self.mod.relpath, node.lineno,
+            f"{what} in `{self.qualname}` syncs the device on the hot path; "
+            "route through a documented choke point, precompute on host, or "
+            "whitelist it in igloo_tpu/lint/sync_hazard.py with a rationale"))
+
+    # --- visitors ---
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        val = node.value
+        name = dotted(val.func) if isinstance(val, ast.Call) else None
+        if name is not None and self._is_jit_maker(name):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.jit_fns.add(t.id)
+            return
+        t = self._expr_tainted(val)
+        if isinstance(val, ast.Call) and name in _UNTAINT_CALLS:
+            t = False
+        for tgt in node.targets:
+            self._bind(tgt, t)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if self._expr_tainted(node.value):
+            self._bind(node.target, True)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        name = dotted(node.func)
+        if name is not None:
+            bare = name.split(".")[-1]
+            if bare in _SYNC_CALLS and isinstance(node.func, ast.Attribute):
+                self._report(node, f"`.{bare}()` call")
+                return
+            if name in _UNTAINT_CALLS:
+                self._report(node, f"`{name}` fetch")
+                return
+            if (name in _CAST_SINKS or name in _NP_SINKS) and node.args and \
+                    self._expr_tainted(node.args[0]):
+                self._report(node, f"`{name}()` over a device value")
+                return
+            if bare in _METHOD_SINKS and isinstance(node.func, ast.Attribute) \
+                    and self._expr_tainted(node.func.value):
+                self._report(node, f"`.{bare}()` over a device value")
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._expr_tainted(node.iter):
+            self._report(node, "iteration over a device value")
+        self._bind(node.target, False)
+        self.generic_visit(node)
+
+    def _check_truth(self, test: ast.AST, node: ast.AST) -> None:
+        exprs = test.values if isinstance(test, ast.BoolOp) else [test]
+        for e in exprs:
+            if isinstance(e, (ast.Compare,)):
+                continue  # comparisons produce device bools but don't sync
+            if self._expr_tainted(e):
+                self._report(node, "truth test over a device value")
+                return
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_truth(node.test, node)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_truth(node.test, node)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_truth(node.test, node)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_truth(node.test, node)
+        self.generic_visit(node)
+
+    # nested functions get their own pass (fresh taint scope)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is not self.fn:
+            self.checker._run_function(
+                self.mod, f"{self.qualname}.{node.name}", node)
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return  # traced lambdas: no host sinks possible in an expression body
+
+
+class SyncHazardChecker(Checker):
+    name = RULE
+
+    def __init__(self):
+        self.out: list[Finding] = []
+        self.used_choke_points: set = set()
+        self.warnings: list[str] = []
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        if not mod.relpath.startswith(HOT_PREFIXES):
+            return ()
+        self.out = []
+        for qual, fn in _top_level_functions(mod.tree):
+            self._run_function(mod, qual, fn)
+        return self.out
+
+    def _run_function(self, mod: LintModule, qualname: str,
+                      fn: ast.AST) -> None:
+        p = _FunctionPass(self, mod, qualname, fn)
+        for stmt in fn.body:
+            p.visit(stmt)
+
+    def finalize(self, modules: list) -> Iterable[Finding]:
+        linted = {m.relpath for m in modules}
+        for (path, qual), _why in sorted(CHOKE_POINTS.items()):
+            if path in linted and (path, qual) not in self.used_choke_points:
+                self.warnings.append(
+                    f"sync-hazard: whitelist entry ({path}, {qual}) matched "
+                    "no sync site — stale entry?")
+        return ()
+
+
+def _top_level_functions(tree: ast.Module):
+    """(qualname, node) for every module-level def and each method of every
+    class (nested defs are handled inside their parent's pass)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub

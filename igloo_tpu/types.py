@@ -133,11 +133,15 @@ class Schema:
     """Ordered, named, typed columns. Mirrors Arrow's Schema but engine-owned."""
 
     def __init__(self, fields: list[Field]):
-        self.fields = list(fields)
+        # a tuple, not a list: Schema rides in jit static aux data and keys
+        # compile caches, so its hash must not be able to drift after the
+        # first use (igloo-lint cache-key: hash over mutable state)
+        self.fields = tuple(fields)
         self._index: dict[str, int] = {}
         for i, f in enumerate(self.fields):
             # last-wins on duplicate names (SQL allows dup output names)
             self._index[f.name] = i
+        self._hash = hash(self.fields)
 
     @property
     def names(self) -> list[str]:
@@ -163,7 +167,7 @@ class Schema:
 
     def __hash__(self) -> int:
         # Schema rides in jit static aux data (pytree aux of DeviceBatch)
-        return hash(tuple(self.fields))
+        return self._hash
 
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return "Schema(" + ", ".join(f"{f.name}: {f.dtype}" for f in self.fields) + ")"

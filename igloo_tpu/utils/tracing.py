@@ -15,8 +15,8 @@ ever installed (SURVEY.md §5.1); here the layer is real and has three parts:
 - `profile_trace()` wraps `jax.profiler.trace` for device-level profiles.
 
 Every counter/histogram name used in the codebase is cataloged in
-docs/observability.md; scripts/check_metrics_names.py fails the verify flow
-when the two drift.
+docs/observability.md; igloo-lint's metric-names checker (`python -m
+igloo_tpu.lint`) fails the verify flow when the two drift.
 """
 from __future__ import annotations
 
@@ -37,6 +37,13 @@ _tls = threading.local()
 # bounded so a server thread answering queries for days cannot grow without
 # limit (the coordinator used to leak its whole query history here)
 ROOTS_MAX = 64
+
+# lock discipline (checked by igloo-lint lock-discipline): the registry maps
+# are hit from every thread; a CounterDelta's backing Counter is shared with
+# adopted worker threads (the GRACE prefetch thread), so all `_data` access
+# holds the module-wide _delta_lock
+_GUARDED_BY = {"_lock": ("_counters", "_hists", "_version"),
+               "_delta_lock": ("_data",)}
 
 
 @dataclass
@@ -201,10 +208,14 @@ class CounterDelta:
             return {k: v for k, v in self._data.items() if v}
 
     def __getitem__(self, name: str) -> int:
-        return self._data[name]
+        # same lock as get()/values(): the backing Counter may be mid-update
+        # on an adopted worker thread (`c[name] += d` is not atomic)
+        with _delta_lock:
+            return self._data[name]
 
     def __contains__(self, name: str) -> bool:
-        return name in self._data
+        with _delta_lock:
+            return name in self._data
 
 
 @contextlib.contextmanager

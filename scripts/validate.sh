@@ -5,8 +5,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== metric-name catalog lint =="
-python scripts/check_metrics_names.py
+echo "== igloo-lint (sync-hazard / cache-key / lock-discipline / metric-names) =="
+python -m igloo_tpu.lint
 
 echo "== ruff (lint) =="
 if python -c "import ruff" 2>/dev/null || command -v ruff >/dev/null; then

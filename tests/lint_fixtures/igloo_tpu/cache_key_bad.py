@@ -1,0 +1,47 @@
+"""cache-key MUST-FLAG fixture: identity tokens, mutable hashes, unordered
+iteration — each feeding something key-shaped."""
+
+_CACHE: dict = {}
+_MEMO: dict = {}
+
+
+def snapshot_token(provider):
+    # id() returned from a token factory — reused after free
+    return id(provider)               # BAD
+
+
+def keyish_binding(obj, filters):
+    key = (id(obj), tuple(filters))   # BAD: id() bound to a key-ish name
+    return key
+
+
+def cache_lookup(arr):
+    ent = _MEMO.get(id(arr))          # BAD: id() as a memo lookup key
+    if ent is None:
+        _CACHE[id(arr)] = arr         # BAD: id() as a cache subscript key
+    return ent
+
+
+def mutable_hash_call(parts):
+    return hash([p.name for p in parts])   # BAD: hash() over a list display
+
+
+class MutableHashed:
+    def __init__(self, fields):
+        self.fields = list(fields)
+
+    def __hash__(self):               # BAD: hashes a mutable attribute
+        return hash(tuple(self.fields))
+
+
+def unordered_key(columns):
+    fp = tuple(columns.keys())        # BAD: dict-order iteration into a key
+    return fp
+
+
+def suppressed_identity(arr):
+    # pin + `is`-validate idiom, documented at the call site:
+    ent = _MEMO.get(id(arr))  # lint: allow(cache-key)
+    if ent is not None and ent[0] is arr:
+        return ent[1]
+    return None

@@ -1,0 +1,40 @@
+"""cache-key MUST-NOT-FLAG twin: real tokens, immutable hashes, sorted
+iteration, and id() in non-key roles (plan-identity maps)."""
+import weakref
+
+_MEMO: dict = {}
+
+
+def snapshot_token(provider):
+    # a weakref token: dead refs can never validate a new object
+    return weakref.ref(provider)
+
+
+def keyish_binding(obj, filters):
+    key = (obj.name, tuple(filters))       # content, not identity
+    return key
+
+
+def plan_identity_map(leaves):
+    # id() for a map scoped to ONE planning pass over live objects is fine
+    leaf_ids = {id(leaf): leaf for leaf in leaves}
+    return leaf_ids
+
+
+def immutable_hash_call(parts):
+    return hash(tuple(p.name for p in parts))
+
+
+class ImmutableHashed:
+    def __init__(self, fields):
+        self.fields = tuple(fields)
+        self._hash = hash(self.fields)
+
+    def __hash__(self):
+        return self._hash
+
+
+def ordered_key(columns):
+    fp = tuple(sorted(columns.keys()))     # sorted: deterministic
+    sig = frozenset(columns.values())      # order-free consumption
+    return fp, sig

@@ -1,0 +1,53 @@
+"""sync-hazard MUST-FLAG fixture: every implicit-sync shape the checker
+knows, in a hot-path module, outside any whitelisted choke point."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def cast_syncs(batch):
+    n = jnp.sum(batch.live)          # device value
+    total = int(n)                   # BAD: int() syncs
+    frac = float(jnp.mean(batch.x))  # BAD: float() over a device value
+    return total, frac
+
+
+def truth_test_syncs(mask):
+    any_hit = jnp.any(mask)
+    if any_hit:                      # BAD: truth test syncs
+        return True
+    while jnp.all(mask):             # BAD: while-test syncs
+        break
+    return bool(jnp.max(mask))       # BAD: bool() syncs
+
+
+def host_materialize_syncs(vals):
+    dev = jnp.asarray(vals) * 2
+    host = np.asarray(dev)           # BAD: np.asarray over a device value
+    item = dev.item()                # BAD: .item() syncs
+    return host, item
+
+
+def iteration_syncs(vals):
+    dev = jnp.cumsum(jnp.asarray(vals))
+    out = []
+    for v in dev:                    # BAD: iterating a device array syncs per element
+        out.append(v)
+    return out
+
+
+def jitted_result_syncs(fn, batch):
+    run = jax.jit(fn)
+    out = run(batch)
+    return int(out.total)            # BAD: jit output is a device value
+
+
+def explicit_fetches(batch):
+    vals = jax.device_get(batch.x)   # BAD: fetch outside a documented choke point
+    n = batch.num_live()             # BAD: num_live() is a sync by definition
+    return vals, n
+
+
+def suppressed_sync(batch):
+    # a documented, deliberate sync rides on an allow comment:
+    return int(jnp.sum(batch.live))  # lint: allow(sync-hazard)

@@ -1,0 +1,40 @@
+"""sync-hazard MUST-NOT-FLAG twin: the same host operations over host data,
+device ops with no host sink, and the untainting device_get assignment."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_math_is_fine(rows):
+    arr = np.asarray(rows)           # numpy in, numpy out: no device
+    total = int(arr.sum())
+    if arr.any():
+        total += len(arr)
+    return float(total)
+
+
+def device_compute_without_sinks(batch):
+    lane = jnp.cumsum(batch.x) * jnp.float64(2.0)
+    keep = batch.live & (lane > 0)   # device compare: lazy, no sync
+    return jnp.where(keep, lane, 0)
+
+
+def metadata_queries_are_host(batch):
+    if jnp.issubdtype(batch.x.dtype, jnp.floating):  # host predicate
+        return jnp.asarray(jnp.finfo(batch.x.dtype).max, batch.x.dtype)
+    cap = int(batch.live.shape[0])   # shape access is static, not a sync
+    return cap
+
+
+def lists_of_device_values_are_host(cols):
+    lanes = [jnp.asarray(c) for c in cols]
+    pad = [None] * len(lanes)        # len() of a host list
+    for lane in lanes:               # iterating the host list, not a lane
+        _ = lane
+    return pad
+
+
+def device_get_output_is_host(batch):
+    host_vals, host_live = jax.device_get((batch.x, batch.live))  # lint: allow(sync-hazard)
+    n = int(host_live.sum())         # host after the fetch: fine
+    return [v for v in host_vals[:n]]

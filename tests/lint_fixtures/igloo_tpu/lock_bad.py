@@ -1,0 +1,36 @@
+"""lock-discipline MUST-FLAG fixture: guarded state touched off-lock."""
+import threading
+
+_GUARDED_BY = {"_lock": ("_entries", "_bytes"), "_g_lock": ("_g_count",)}
+
+_g_lock = threading.Lock()
+_g_count = 0
+
+
+def bump_global():
+    global _g_count
+    _g_count += 1          # BAD: module-global guarded state, lock not held
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}     # __init__ is exempt (not shared yet)
+        self._bytes = 0
+
+    def put(self, key, value, nbytes):
+        with self._lock:
+            self._entries[key] = value
+            self._bytes += nbytes
+
+    def get(self, key):
+        return self._entries.get(key)   # BAD: read outside the lock
+
+    def evict(self, key):
+        ent = self._entries.pop(key, None)   # BAD: write outside the lock
+        if ent is not None:
+            self._bytes -= ent.nbytes        # BAD: write outside the lock
+
+    def nbytes_sloppy(self):
+        # suppression carries the rationale with it:
+        return self._bytes  # lint: allow(lock-discipline)

@@ -1,0 +1,42 @@
+"""lock-discipline MUST-NOT-FLAG twin: every access holds the declared lock
+or sits in a caller-locked method."""
+import threading
+
+_GUARDED_BY = {"_lock": ("_entries", "_bytes"), "_g_lock": ("_g_count",)}
+
+_g_lock = threading.Lock()
+_g_count = 0
+
+
+def bump_global():
+    global _g_count
+    with _g_lock:
+        _g_count += 1
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._bytes = 0
+
+    def put(self, key, value, nbytes):
+        with self._lock:
+            self._entries[key] = value
+            self._bytes += nbytes
+            self._evict_locked()
+
+    def get(self, key):
+        with self._lock:
+            return self._entries.get(key)
+
+    def _evict_locked(self):
+        while self._bytes > 100 and self._entries:
+            _, ent = self._entries.popitem()
+            self._bytes -= ent.nbytes
+
+    def drain(self):
+        """Flush everything. Caller-locked: the shutdown path already holds
+        self._lock across the whole teardown."""
+        self._entries.clear()
+        self._bytes = 0

@@ -1,0 +1,17 @@
+"""metric-names MUST-FLAG fixture (checked against metric_catalog.md):
+undocumented literal, uncovered f-string prefix, unknown dynamic prefix.
+(No trailing comments after the calls: the name scan reads to the call's
+closing paren at end-of-line, same as the real codebase's formatting — so
+the BAD markers sit on the line ABOVE each offending call.)"""
+from igloo_tpu.utils import tracing
+
+
+def record(store, reason):
+    # BAD: undocumented literal name
+    tracing.counter("fixture.undocumented")
+    # BAD: no fixture.dynamic.* wildcard in the catalog
+    tracing.counter(f"fixture.dynamic.{reason}")
+    # BAD: dynamic prefix not in DYNAMIC_PREFIXES
+    tracing.counter(f"{store.metric_prefix}.hit")
+    # documented, fine:
+    tracing.histogram("fixture.latency_ms", 1.0)

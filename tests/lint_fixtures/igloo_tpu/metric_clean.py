@@ -1,0 +1,12 @@
+"""metric-names MUST-NOT-FLAG twin (checked against metric_catalog.md)."""
+from igloo_tpu.utils import tracing
+
+
+def record(ok, reason):
+    # documented verbatim:
+    tracing.counter("fixture.hits")
+    # ternary arms, both documented:
+    tracing.counter("fixture.ok" if ok else "fixture.fail")
+    # covered by the fixture.covered.* wildcard:
+    tracing.counter(f"fixture.covered.{reason}")
+    tracing.histogram("fixture.latency_ms", 2.5)

@@ -1,0 +1,167 @@
+"""igloo-lint: each checker must flag its bad fixture, pass its clean twin,
+honor suppressions, and report ZERO findings over the real tree (pure AST —
+the whole file runs in a few seconds, no jax backend)."""
+import time
+from pathlib import Path
+
+from igloo_tpu.lint import LintModule, iter_package_files, run_lint
+from igloo_tpu.lint.cache_key import CacheKeyChecker
+from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
+from igloo_tpu.lint.metric_names import MetricNamesChecker
+from igloo_tpu.lint.sync_hazard import SyncHazardChecker
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+PKG = FIXTURES / "igloo_tpu"
+
+
+def _lint(paths, checkers):
+    findings, _warnings = run_lint(paths=paths, checkers=checkers,
+                                   root=FIXTURES)
+    return findings
+
+
+# --- sync-hazard ------------------------------------------------------------
+
+def test_sync_hazard_flags_bad_fixture():
+    f = _lint([PKG / "exec" / "sync_bad.py"], [SyncHazardChecker()])
+    lines = {x.line for x in f}
+    assert all(x.rule == "sync-hazard" for x in f)
+    # one finding per BAD marker in the fixture; the suppressed sync absent
+    src = (PKG / "exec" / "sync_bad.py").read_text().splitlines()
+    bad_lines = {i for i, ln in enumerate(src, 1) if "# BAD" in ln}
+    assert lines == bad_lines, (sorted(lines), sorted(bad_lines))
+
+
+def test_sync_hazard_passes_clean_fixture():
+    assert _lint([PKG / "exec" / "sync_clean.py"],
+                 [SyncHazardChecker()]) == []
+
+
+def test_sync_hazard_scope_is_hot_modules_only():
+    # same hazardous file outside exec//parallel/ is out of scope
+    f, _ = run_lint(paths=[PKG / "exec" / "sync_bad.py"],
+                    checkers=[SyncHazardChecker()], root=PKG)
+    assert f == []  # relpath no longer starts with igloo_tpu/exec/
+
+
+# --- cache-key --------------------------------------------------------------
+
+def test_cache_key_flags_bad_fixture():
+    f = _lint([PKG / "cache_key_bad.py"], [CacheKeyChecker()])
+    lines = {x.line for x in f}
+    src = (PKG / "cache_key_bad.py").read_text().splitlines()
+    bad_lines = {i for i, ln in enumerate(src, 1) if "# BAD" in ln}
+    assert lines == bad_lines, (sorted(lines), sorted(bad_lines))
+
+
+def test_cache_key_passes_clean_fixture():
+    assert _lint([PKG / "cache_key_clean.py"], [CacheKeyChecker()]) == []
+
+
+# --- lock-discipline --------------------------------------------------------
+
+def test_lock_discipline_flags_bad_fixture():
+    f = _lint([PKG / "lock_bad.py"], [LockDisciplineChecker()])
+    lines = {x.line for x in f}
+    src = (PKG / "lock_bad.py").read_text().splitlines()
+    bad_lines = {i for i, ln in enumerate(src, 1) if "# BAD" in ln}
+    assert lines == bad_lines, (sorted(lines), sorted(bad_lines))
+
+
+def test_lock_discipline_passes_clean_fixture():
+    assert _lint([PKG / "lock_clean.py"], [LockDisciplineChecker()]) == []
+
+
+def test_lock_discipline_ignores_undeclared_modules():
+    # no _GUARDED_BY -> nothing checked, even with bare lock usage
+    f = _lint([PKG / "cache_key_clean.py"], [LockDisciplineChecker()])
+    assert f == []
+
+
+# --- metric-names -----------------------------------------------------------
+
+def _metric_checker():
+    return MetricNamesChecker(doc_path=FIXTURES / "metric_catalog.md")
+
+
+def test_metric_names_flags_bad_fixture():
+    f = _lint([PKG / "metric_bad.py"], [_metric_checker()])
+    lines = {x.line for x in f}
+    src = (PKG / "metric_bad.py").read_text().splitlines()
+    # markers sit on the comment line ABOVE each offending call (a trailing
+    # comment would extend the call's scan region past its own line)
+    bad_lines = {i + 1 for i, ln in enumerate(src, 1)
+                 if ln.strip().startswith("# BAD")}
+    assert lines == bad_lines, (sorted(lines), sorted(bad_lines))
+
+
+def test_metric_names_passes_clean_fixture():
+    assert _lint([PKG / "metric_clean.py"], [_metric_checker()]) == []
+
+
+# --- framework --------------------------------------------------------------
+
+def test_suppression_comment_silences_one_line():
+    mod = LintModule.parse(PKG / "exec" / "sync_bad.py", root=FIXTURES)
+    # the suppressed line exists and would otherwise be a finding
+    assert any("lint: allow(sync-hazard)" in ln
+               for ln in mod.text.splitlines())
+    suppressed = [ln for ln, rules in mod.allows.items()
+                  if "sync-hazard" in rules]
+    assert suppressed, "fixture lost its suppression"
+
+
+def test_cli_accepts_relative_and_directory_paths(capsys, monkeypatch):
+    from igloo_tpu.lint.__main__ import main
+    repo = Path(__file__).resolve().parent.parent
+    monkeypatch.chdir(repo)
+    # relative file arg (the documented usage) must lint, not traceback
+    assert main(["-q", "--select", "cache-key",
+                 "tests/lint_fixtures/igloo_tpu/cache_key_clean.py"]) == 0
+    # a directory arg expands to its .py files
+    assert main(["-q", "--select", "cache-key",
+                 "tests/lint_fixtures/igloo_tpu"]) == 1
+    capsys.readouterr()
+
+
+def test_cache_key_findings_are_not_duplicated():
+    f = _lint([PKG / "cache_key_bad.py"], [CacheKeyChecker()])
+    keyed = [(x.line, x.message) for x in f]
+    assert len(keyed) == len(set(keyed)), keyed
+
+
+def test_metric_names_partial_run_skips_stale_catalog_warnings():
+    c = MetricNamesChecker()  # real docs/observability.md catalog
+    _findings, warnings = run_lint(
+        paths=[Path(__file__).resolve().parent.parent / "igloo_tpu" /
+               "exec" / "cache.py"], checkers=[c])
+    assert not any("matches no code call site" in w for w in warnings), \
+        warnings[:3]
+
+
+def test_cli_exit_codes(capsys):
+    from igloo_tpu.lint.__main__ import main
+    # findings -> 1 (cache-key is scope-free, so the repo-root-relative
+    # fixture path doesn't matter)
+    assert main(["-q", "--select", "cache-key",
+                 str(PKG / "cache_key_bad.py")]) == 1
+    capsys.readouterr()
+    assert main(["--select", "no-such-rule"]) == 2
+    assert main(["--list-rules"]) == 0
+    capsys.readouterr()
+
+
+# --- the real tree ----------------------------------------------------------
+
+def test_package_tree_is_clean_and_fast():
+    t0 = time.perf_counter()
+    findings, _warnings = run_lint()
+    elapsed = time.perf_counter() - t0
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (budget: a few seconds)"
+    # the four domain modules actually declare their guarded state
+    declared = 0
+    for p in iter_package_files():
+        if "_GUARDED_BY" in p.read_text():
+            declared += 1
+    assert declared >= 4

@@ -296,11 +296,9 @@ def test_coordinator_prometheus_aggregation():
 
 
 def test_metrics_name_lint_passes():
-    """The verify-flow lint itself: code names match the documented catalog."""
-    import subprocess
-    import sys
-    r = subprocess.run(
-        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
-                                      "scripts", "check_metrics_names.py")],
-        capture_output=True, text=True)
-    assert r.returncode == 0, r.stdout + r.stderr
+    """The verify-flow lint itself: code names match the documented catalog
+    (now the metric-names checker inside igloo-lint; tests/test_lint.py
+    covers the other rules and the fixtures)."""
+    from igloo_tpu.lint import run_lint
+    findings, _warnings = run_lint(select={"metric-names"})
+    assert findings == [], "\n".join(f.render() for f in findings)
