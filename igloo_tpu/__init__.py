@@ -22,33 +22,15 @@ jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: join-heavy TPC-H stages cost minutes of
 # cold compile on TPU; caching them on disk makes every process after the
-# first start warm. IGLOO_TPU_COMPILE_CACHE: 0/false/off disables,
-# 1/true/on (or unset) uses the default directory, anything else is the
-# directory to use.
-import os as _os  # noqa: E402
+# first start warm, and the cluster tier replicates entries so a shape
+# compiles once per CLUSTER (igloo_tpu/compile_cache.py has the policy,
+# telemetry hooks, and the cluster transfer; docs/compile_cache.md the
+# knobs). A setup failure warns once and bumps `compile_cache.disabled`
+# instead of dying silently.
+from igloo_tpu import compile_cache as _compile_cache  # noqa: E402
 
-_cache_raw = _os.environ.get("IGLOO_TPU_COMPILE_CACHE", "1")
-_cache_flag = _cache_raw.strip().lower()
-if _cache_flag in ("0", "false", "off", "no", ""):
-    _cache_dir = None
-elif _cache_flag in ("1", "true", "on", "yes"):
-    # default: alongside the package tree when writable (repo checkouts),
-    # else the user cache dir (pip installs into read-only site-packages)
-    _parent = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-    if _os.access(_parent, _os.W_OK):
-        _cache_dir = _os.path.join(_parent, ".xla_cache")
-    else:
-        _cache_dir = _os.path.join(_os.path.expanduser("~"), ".cache",
-                                   "igloo_tpu_xla")
-else:
-    _cache_dir = _cache_raw
-if _cache_dir:
-    try:
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:  # older jax without the knobs: cold compiles only
-        pass
+_compile_cache.configure()
+_compile_cache.install_metrics()
 
 from igloo_tpu import types  # noqa: E402,F401
 from igloo_tpu.version import __version__  # noqa: E402,F401

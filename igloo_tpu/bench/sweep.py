@@ -53,7 +53,7 @@ _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 # not just detected
 _DELTA_PREFIXES = ("jit.", "pack.", "grace.", "chunked.", "xfer.",
                    "cache.", "result_cache.", "engine.", "fused.", "join.",
-                   "exchange.")
+                   "exchange.", "compile_cache.")
 
 
 def _peak_hbm_bytes() -> int:
@@ -107,6 +107,12 @@ def run_query(engine, sql: str, trials: int) -> dict:
     rec = {"cold_s": round(cold, 4),
            "warm_trials": [round(w, 4) for w in warm],
            "cached_s": round(cached, 4),
+           # persistent-XLA-cache traffic on the COLD run: hits > 0 with a
+           # small cold_s means the "cold" compile was served from disk —
+           # the number that makes the cold-run trajectory across BENCH
+           # rounds interpretable (cleared vs pre-warmed cache dir)
+           "compile_cache_hits": cold_delta.get("compile_cache.hit"),
+           "compile_cache_misses": cold_delta.get("compile_cache.miss"),
            "packed": any(query_delta.get(k) > 0 for k in _PACK_COUNTERS),
            # cold-run counter deltas (trajectory explanations) + the per-warm
            # transfer numbers that prove the scan cache amortized uploads

@@ -510,7 +510,31 @@ class CoordinatorServer(flight.FlightServerBase):
                     self._sync_worker_tables(w)
                 except Exception:
                     pass
-            return [b"{}"]
+            # propagate the persistent compile-cache setting + entry listing:
+            # the worker adopts the setting when it has none of its own and
+            # pre-warms by pulling entries it is missing (compile_cache_get),
+            # so a fresh worker starts with every program the cluster has
+            # ever compiled (docs/compile_cache.md)
+            import os
+            from igloo_tpu import compile_cache
+            return [json.dumps({"compile_cache": {
+                "setting": os.environ.get("IGLOO_TPU_COMPILE_CACHE", "1"),
+                "entries": compile_cache.entry_names(
+                    min_age_s=compile_cache.TRANSFER_MIN_AGE_S),
+            }}).encode()]
+        if action.type == "compile_cache_get":
+            # raw entry bytes by XLA cache filename (NOT JSON — workers use
+            # rpc.flight_action_raw); empty body = no such entry
+            from igloo_tpu import compile_cache
+            data = compile_cache.read_entry(req.get("name", ""))
+            return [data if data is not None else b""]
+        if action.type == "compile_cache_put":
+            # worker pushing a freshly compiled entry back to the cluster
+            from igloo_tpu import compile_cache
+            stored = compile_cache.write_entry(
+                req.get("name", ""),
+                compile_cache.decode_entry(req.get("data", "")))
+            return [json.dumps({"stored": stored}).encode()]
         if action.type == "heartbeat":
             ok = self.membership.heartbeat(req["id"], req.get("addr", ""))
             return [json.dumps({"ok": ok}).encode()]
@@ -545,7 +569,13 @@ class CoordinatorServer(flight.FlightServerBase):
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
-        return [("register_worker", "worker membership registration"),
+        return [("register_worker", "worker membership registration "
+                                    "(returns compile-cache setting + "
+                                    "entry listing for pre-warm)"),
+                ("compile_cache_get",
+                 "persistent-compile-cache entry bytes by filename"),
+                ("compile_cache_put",
+                 "store a worker-compiled persistent-cache entry"),
                 ("heartbeat", "worker liveness heartbeat"),
                 ("register_table", "register a table from a provider spec"),
                 ("cluster_status", "membership + catalog snapshot"),

@@ -150,6 +150,23 @@ def flight_action_raw(addr: str, name: str,
     return results[0].body.to_pybytes() if results else b""
 
 
+def flight_actions_raw(addr: str, actions):
+    """Run several action RPCs over ONE connection, yielding each action's
+    raw first-result bytes in order. `actions` iterates (name, payload)
+    pairs. The connection closes when the generator is exhausted or closed —
+    the worker's registration pre-warm pulls hundreds of compile-cache
+    entries and must not pay a TCP connect/teardown per entry."""
+    client = flight.connect(normalize(addr))
+    try:
+        for name, payload in actions:
+            body = json.dumps(payload).encode() if payload is not None else b""
+            results = list(client.do_action(flight.Action(name, body),
+                                            call_options()))
+            yield results[0].body.to_pybytes() if results else b""
+    finally:
+        client.close()
+
+
 def flight_stream_batches(addr: str, ticket):
     """Streaming do_get: returns (schema, record-batch generator). The
     connection stays open until the generator is exhausted (or closed), so

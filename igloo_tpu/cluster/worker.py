@@ -282,6 +282,14 @@ class Worker:
         self.heartbeat_interval_s = heartbeat_interval_s
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        # compile-cache entry names this worker knows the coordinator has
+        # (seeded at registration, grown by pushes); touched only by the
+        # registering thread and then the heartbeat thread, never both
+        self._cache_known: set = set()
+        # per-entry consecutive push failures: an entry that keeps failing
+        # (e.g. bigger than the transport's message cap) is given up on after
+        # a few beats instead of starving every entry that sorts after it
+        self._push_failures: dict = {}
 
     @property
     def address(self) -> str:
@@ -297,8 +305,134 @@ class Worker:
         return flight_action(self.coordinator, name, payload)
 
     def _register(self) -> None:
-        self._coordinator_action("register_worker", {
+        resp = self._coordinator_action("register_worker", {
             "id": self.server.worker_id, "addr": self.server.advertise})
+        try:
+            self._adopt_compile_cache(resp.get("compile_cache") or {})
+        except Exception:
+            # pre-warm is an optimization; registration must never fail on it
+            tracing.counter("compile_cache.prewarm_failed")
+
+    def _adopt_compile_cache(self, info: dict) -> None:
+        """Registration-time cache sync: adopt the coordinator's
+        IGLOO_TPU_COMPILE_CACHE setting when this process has none of its
+        own, then PRE-WARM by pulling every persistent-cache entry the
+        coordinator has that we don't — a fresh worker serves its first
+        fragment with the cluster's whole compile history on disk."""
+        import os
+
+        from igloo_tpu import compile_cache
+        setting = info.get("setting")
+        if setting is not None and "IGLOO_TPU_COMPILE_CACHE" not in os.environ:
+            compile_cache.configure(setting)
+        local = set(compile_cache.entry_names())
+        remote = list(info.get("entries") or ())
+        # only REMOTE names are "known to the coordinator": local entries the
+        # coordinator lacks (compiled before registration, or a pre-seeded
+        # cache) must still be pushed on the first heartbeat
+        self._cache_known = set(remote)
+        if compile_cache.active_dir() is None:
+            return
+        missing = [n for n in remote if n not in local]
+        if not missing:
+            return
+        # pull in a DAEMON thread: a mature cluster's cache is hundreds of
+        # entries (tens of MB each), and blocking _register on the transfer
+        # would outlast the membership timeout (coordinator sweeps a worker
+        # silent for 15 s) before the heartbeat thread even starts. Pulled
+        # names are already in _cache_known (they came from `remote`), so
+        # the thread never mutates shared state; write_entry is atomic.
+        threading.Thread(target=self._prewarm_pull, args=(missing,),
+                         daemon=True).start()
+
+    def _prewarm_pull(self, missing: list) -> None:
+        from igloo_tpu import compile_cache
+        done = 0
+        try:
+            # one connection for the whole pre-warm (rpc.flight_actions_raw):
+            # a connect/teardown per entry would dominate the transfer
+            pulls = rpc.flight_actions_raw(
+                self.coordinator,
+                (("compile_cache_get", {"name": n}) for n in missing))
+            for name, data in zip(missing, pulls):
+                done += 1
+                if data and compile_cache.write_entry(name, data):
+                    tracing.counter("compile_cache.pull")
+        except Exception:
+            # the batch connection died — usually ONE entry past the
+            # transport's message cap. Finish per-entry so everything after
+            # it still warms (the push side has the same give-up rule);
+            # per-entry failures are skipped, not fatal.
+            for name in missing[done:]:
+                try:
+                    data = rpc.flight_action_raw(
+                        self.coordinator, "compile_cache_get", {"name": name})
+                    if data and compile_cache.write_entry(name, data):
+                        tracing.counter("compile_cache.pull")
+                except Exception:
+                    tracing.counter("compile_cache.prewarm_failed")
+
+    def _push_compile_cache(self) -> None:
+        """Heartbeat-time push of entries this worker compiled since the
+        last sync, keyed by XLA cache filename — the return leg that makes
+        the cache CLUSTER-wide rather than coordinator-seeded."""
+        from igloo_tpu import compile_cache
+        # only STABLE entries ship: XLA writes cache files non-atomically,
+        # and a truncated blob pushed once would pin itself cluster-wide
+        candidates = [n for n in compile_cache.entry_names(
+                          min_age_s=compile_cache.TRANSFER_MIN_AGE_S)
+                      if n not in self._cache_known]
+        if not candidates:
+            return
+        # one connection for the whole beat: a cold bench run leaves dozens
+        # of fresh entries, and a connect/teardown per entry on the heartbeat
+        # thread would eat into the coordinator's 15s liveness window.
+        # `attempted` is appended before each action is yielded, so when
+        # result i arrives attempted[i] is its name; entries are read lazily
+        # so at most one payload is in memory at a time.
+        attempted: list = []
+
+        def actions():
+            for name in candidates:
+                data = compile_cache.read_entry(name)
+                self._cache_known.add(name)
+                if data is None:
+                    continue
+                attempted.append(name)
+                yield ("compile_cache_put", {
+                    "name": name, "data": compile_cache.encode_entry(data)})
+
+        confirmed = 0
+        try:
+            for i, body in enumerate(rpc.flight_actions_raw(
+                    self.coordinator, actions())):
+                name = attempted[i]
+                confirmed = i + 1
+                resp = json.loads(body) if body else {}
+                # {"stored": false} is a real failure (coordinator disk
+                # error, payload rejected) — counting it as a push would
+                # drop the entry from replication forever
+                if resp.get("stored"):
+                    tracing.counter("compile_cache.push")
+                    self._push_failures.pop(name, None)
+                else:
+                    self._note_push_failure(name)
+        except Exception:
+            # connection died mid-batch (coordinator restart, or one entry
+            # past the transport's message cap): everything unconfirmed
+            # retries next beat, with the 3-strike give-up so one poisonous
+            # entry can't starve those sorting after it
+            for name in attempted[confirmed:]:
+                self._note_push_failure(name)
+
+    def _note_push_failure(self, name: str) -> None:
+        """3-strike bookkeeping: un-know the entry so the next beat retries
+        it, until it keeps failing (e.g. past the transport's message cap) —
+        then leave it known so entries sorting after it still ship."""
+        fails = self._push_failures.get(name, 0) + 1
+        self._push_failures[name] = fails
+        if fails < 3:
+            self._cache_known.discard(name)
 
     def _heartbeat_loop(self) -> None:
         # retry/backoff the reference leaves as a comment (main.rs:37-38):
@@ -314,6 +448,7 @@ class Worker:
                 if not resp.get("ok", True):
                     self._register()
                     tracing.counter("worker.reregistrations")
+                self._push_compile_cache()
             except Exception:
                 tracing.counter("worker.heartbeat_failures")
 

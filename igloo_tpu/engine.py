@@ -193,6 +193,14 @@ class QueryEngine:
                     ms = delta.get(f"grace.{ph}_ms", 0)
                     if ms:
                         text += f"\n-- grace.{ph}_s: {ms / 1000:.3f}"
+                # persistent XLA compile-cache traffic for THIS query (the
+                # jax.monitoring hooks in igloo_tpu/compile_cache.py run on
+                # the compiling thread, so the delta is exact)
+                cc_hit = delta.get("compile_cache.hit", 0)
+                cc_miss = delta.get("compile_cache.miss", 0)
+                if cc_hit or cc_miss:
+                    text += (f"\n-- compile_cache: hits={cc_hit} "
+                             f"misses={cc_miss}")
             return QueryResult(pa.table({"plan": text.split("\n")}), plan=plan,
                                elapsed_s=time.perf_counter() - t0, stats=qs)
         if isinstance(stmt, A.CreateTableAsStmt):

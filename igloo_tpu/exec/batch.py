@@ -38,16 +38,16 @@ from igloo_tpu.types import (
     DataType, Field, Schema, TypeId,
 )
 
-MIN_CAPACITY = 8
+from igloo_tpu.exec.capacity import MIN_CAPACITY, canonical_capacity
 
 
 def round_capacity(n: int) -> int:
-    """Pad row counts to power-of-two buckets so XLA recompiles rarely (shape bucketing;
-    cf. SURVEY.md §7 hard part 5)."""
-    c = MIN_CAPACITY
-    while c < n:
-        c <<= 1
-    return c
+    """Pad row counts to the canonical shape family so XLA recompiles rarely
+    (shape bucketing; cf. SURVEY.md §7 hard part 5). Delegates to the
+    engine-wide capacity policy (exec/capacity.py): exact pow2 for small
+    batches, a coarser geometric family with hysteresis above 2^16 so
+    neighboring scale factors lower to the same compiled programs."""
+    return canonical_capacity(n)
 
 
 # 64-bit mixing constants (splitmix64 finalizer) used for dictionary/string hashing.

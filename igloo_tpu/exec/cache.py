@@ -42,12 +42,18 @@ class SnapshotLRU:
     """Thread-safe byte-budget LRU with snapshot validation — the shared core
     of the HBM scan cache (BatchCache) and the host query-result cache
     (exec/result_cache.ResultCache). Subclasses set `counter_prefix` and
-    `_match_table` (how invalidate_table selects entries)."""
+    `_match_table` (how invalidate_table selects entries). `capacity` is an
+    optional ENTRY-count bound enforced beside the byte budget (the
+    reference's declared-but-never-enforced CacheConfig.capacity, gap G7):
+    byte budgets alone let thousands of tiny entries pile up, which bloats
+    every invalidation sweep."""
 
     counter_prefix = "cache"
 
-    def __init__(self, budget_bytes: int = 1 << 30):
+    def __init__(self, budget_bytes: int = 1 << 30,
+                 capacity: Optional[int] = None):
         self.budget_bytes = int(budget_bytes)
+        self.capacity = int(capacity) if capacity is not None else None
         self._entries: OrderedDict = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
@@ -92,6 +98,12 @@ class SnapshotLRU:
                 self._bytes -= ev.nbytes
                 self.evictions += 1
                 counter(f"{self.counter_prefix}.evict")
+            while self.capacity is not None and \
+                    len(self._entries) > self.capacity:
+                _, ev = self._entries.popitem(last=False)
+                self._bytes -= ev.nbytes
+                self.evictions += 1
+                counter(f"{self.counter_prefix}.evicted")
 
     def _match_table(self, key, entry: CacheEntry, table_key: str) -> bool:
         raise NotImplementedError

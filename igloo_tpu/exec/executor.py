@@ -359,8 +359,12 @@ class Executor:
         spec = attach_dicts(spec, meta.dicts, meta.bounds)
         if int(n) <= spec.capacity:
             return arrow_from_host(spec, host_live, host_vals, host_nulls)
-        # result larger than the fetch window: exact compact + full fetch
-        want = round_capacity(int(n))
+        # result larger than the fetch window: exact compact + full fetch.
+        # Clamp to the batch's own capacity (already a family member): the
+        # live count can sit in the hysteresis band just under it, and an
+        # un-clamped round would pad the fetch a full family step PAST the
+        # rows that exist
+        want = min(round_capacity(int(n)), big.capacity)
         fp = ("compact", batch_proto_key(big), want)
 
         def build():
@@ -408,8 +412,9 @@ class Executor:
             return self._exact_copy().execute_to_arrow(plan)
         if int(host_n) <= cap:
             return arrow_from_host(spec, host_live, host_vals, host_nulls)
-        # overflow: compact to the exact capacity and refetch
-        want = round_capacity(int(host_n))
+        # overflow: compact to the exact capacity and refetch (clamped to the
+        # batch's own capacity — see the fused path's compact above)
+        want = min(round_capacity(int(host_n)), batch.capacity)
         fp = ("compact", batch_proto_key(batch), want)
 
         def build_full():
@@ -914,9 +919,11 @@ class Executor:
             pick = choose_direct_build(use_lk, use_rk, left.capacity,
                                        right.capacity, jt, banned=banned)
             if pick is not None:
-                side, (blo, bhi), ki = pick
+                # (blo, tsize) is the canonical positional table — quantized
+                # in choose_direct_build so these fingerprint constants are
+                # shape-class values, not raw data bounds (jit-key rule)
+                side, (blo, tsize), ki = pick
                 swapped = side == "left"
-                tsize = bhi - blo + 1
                 pks = use_rk if swapped else use_lk
                 bks = use_lk if swapped else use_rk
                 pkey, bkey = pks[ki], bks[ki]

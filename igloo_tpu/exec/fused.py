@@ -229,13 +229,20 @@ class FusedCompiler:
         meta = NodeMeta(plan.schema, [c.dictionary for c in batch.columns],
                         [c.bounds for c in batch.columns], batch.capacity)
         # NOTE: deliberately content-light — dictionary content feeds compiled
-        # code through ConstPool args (pool.signature() keys sizes); bounds DO
-        # join the key because they become direct-join program constants
+        # code through ConstPool args (pool.signature() keys sizes). Bounds
+        # join the key only in CANONICAL form (quantized grid, see
+        # exec/capacity.py): every bounds-derived static decision that shapes
+        # the program (direct-join base/size, seg_dims offsets, pack radices)
+        # is pushed into the key by its own node, so coarsening here is sound
+        # and lets near scale factors share one fused program.
+        from igloo_tpu.exec.capacity import canonical_direct_table
         self._push(("scan", plan.table, tuple(plan.projection or ()),
                     repr(plan.pushed_filters), plan.partition,
                     plan.schema, batch.capacity,
                     tuple(c.nulls is not None for c in batch.columns),
-                    tuple(meta.bounds)))
+                    tuple(canonical_direct_table(b[0], b[1])
+                          if b is not None else None
+                          for b in meta.bounds)))
 
         def fn(leaves, consts, ctx, _i=idx):
             return leaves[_i]
@@ -366,9 +373,10 @@ class FusedCompiler:
     def _c_join_direct(self, plan, jfp, jfp_core, pick, lfn, lmeta, rfn,
                        rmeta, use_lk, use_rk, residual, out_dicts, out_bounds):
         jt = plan.join_type
-        side, (blo, bhi), ki = pick
+        # canonical positional table (see choose_direct_build): blo/tsize are
+        # family-quantized shape-class constants, safe in the fused cache key
+        side, (blo, tsize), ki = pick
         swapped = side == "left"
-        tsize = bhi - blo + 1
         pks = use_rk if swapped else use_lk
         bks = use_lk if swapped else use_rk
         pkey, bkey = pks[ki], bks[ki]

@@ -17,7 +17,14 @@ import hashlib
 import json
 import os
 import tempfile
+import threading
 from typing import Optional
+
+# lock discipline (checked by igloo-lint lock-discipline): one HintStore is
+# shared by every executor the engine builds, and `put`/`flush` run both on
+# the query thread and on the GRACE prefetch thread; `_data`/`_dirty`
+# read-modify-writes must hold the store lock
+_GUARDED_BY = {"_lock": ("_data", "_dirty")}
 
 
 def _digest(key) -> str:
@@ -27,6 +34,7 @@ def _digest(key) -> str:
 class HintStore:
     def __init__(self, path: Optional[str]):
         self._path = path
+        self._lock = threading.Lock()
         self._data: dict[str, int] = {}
         self._dirty = False
         if path and os.path.exists(path):
@@ -37,38 +45,42 @@ class HintStore:
                 self._data = {}
 
     def get(self, key) -> Optional[int]:
-        return self._data.get(_digest(key))
+        with self._lock:
+            return self._data.get(_digest(key))
 
     def put(self, key, n: int) -> None:
         d = _digest(key)
-        if self._data.get(d) != n:
-            self._data[d] = int(n)
-            self._dirty = True
+        with self._lock:
+            if self._data.get(d) != n:
+                self._data[d] = int(n)
+                self._dirty = True
 
     def remove(self, key) -> None:
-        if self._data.pop(_digest(key), None) is not None:
-            self._dirty = True
+        with self._lock:
+            if self._data.pop(_digest(key), None) is not None:
+                self._dirty = True
 
     def flush(self) -> None:
-        if not self._dirty or not self._path:
-            return
-        self._dirty = False
-        try:
-            os.makedirs(os.path.dirname(self._path), exist_ok=True)
-            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self._path))
-            with os.fdopen(fd, "w") as f:
-                json.dump(self._data, f)
-            os.replace(tmp, self._path)
-        except Exception:
-            pass  # hints are an optimization; never fail a query over them
+        # the file write stays INSIDE the lock: two racing flushes (query
+        # thread + GRACE prefetch thread) could otherwise os.replace an older
+        # snapshot over a newer one, silently dropping a just-adopted hint
+        with self._lock:
+            if not self._dirty or not self._path:
+                return
+            self._dirty = False
+            try:
+                os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self._path))
+                with os.fdopen(fd, "w") as f:
+                    json.dump(self._data, f)
+                os.replace(tmp, self._path)
+            except Exception:
+                pass  # hints are an optimization; never fail a query on them
 
 
 def default_store() -> HintStore:
     """Store beside the persistent XLA cache (same enable/disable knob)."""
-    import jax
-    try:
-        cache_dir = jax.config.jax_compilation_cache_dir
-    except AttributeError:
-        cache_dir = None
+    from igloo_tpu import compile_cache
+    cache_dir = compile_cache.active_dir()
     return HintStore(os.path.join(cache_dir, "nhints.json")
                      if cache_dir else None)

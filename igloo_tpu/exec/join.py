@@ -490,16 +490,24 @@ def choose_direct_build(lks: list, rks: list, left_cap: int,
                         right_cap: int, join_type: JoinType,
                         banned: frozenset = frozenset()):
     """Pick the build side + key for a direct join, or None when inapplicable.
-    Returns (side, (lo, hi), key_idx) with side in {"left", "right"}. A
+    Returns (side, (base, table_size), key_idx) with side in {"left",
+    "right"}; (base, table_size) is the CANONICAL positional table
+    (exec/capacity.canonical_direct_table) — size quantized to the capacity
+    family and base grid-aligned, so the raw key bounds never become program
+    constants and neighboring scale factors share one compiled join. A
     (side, key) qualifies when the key's bounds span <= DIRECT_RANGE_BUDGET
     and the side's row capacity could plausibly be unique over that range
-    (cap <= 2*range — power-of-two padding can double the row count); among
-    qualifiers the smaller side wins (PK side in every FK join). Remaining key
+    (cap <= its canonical table size: any padded batch whose live rows fit
+    the range fits the table, whatever the family's padding ratio or
+    hysteresis — a looser-than-exact test whose wrong picks the runtime
+    duplicate flag repairs and negative-caches); among qualifiers the
+    smaller side wins (PK side in every FK join). Remaining key
     pairs become post-gather equality checks, so every key must be
     integer-family. The runtime duplicate check backstops a wrong pick;
     `banned` carries sides that PROVED duplicated on earlier runs (the
     ("nodirect", jfp_core, side) negative cache), so the other side still
     gets its chance."""
+    from igloo_tpu.exec.capacity import canonical_direct_table
     if join_type is JoinType.CROSS or not lks:
         return None
     if not all(_direct_key_ok(c) for c in lks + rks):
@@ -513,15 +521,18 @@ def choose_direct_build(lks: list, rks: list, left_cap: int,
             if b is None:
                 continue
             rng = int(b[1]) - int(b[0]) + 1
-            if rng <= DIRECT_RANGE_BUDGET and cap <= 2 * rng:
-                options.append((cap, rng, side, (int(b[0]), int(b[1])), i))
+            if rng > DIRECT_RANGE_BUDGET:
+                continue
+            base, tsize = canonical_direct_table(int(b[0]), int(b[1]))
+            if cap <= tsize <= DIRECT_RANGE_BUDGET:
+                options.append((cap, rng, side, (base, tsize), i))
     if not options:
         tracing.counter("join.direct_ineligible")
         return None
     options.sort(key=lambda o: (o[0], o[1], o[2], o[4]))
-    _, _, side, bounds, idx = options[0]
+    _, _, side, table, idx = options[0]
     tracing.counter("join.direct_eligible")
-    return side, bounds, idx
+    return side, table, idx
 
 
 def direct_probe(probe: DeviceBatch, build: DeviceBatch,

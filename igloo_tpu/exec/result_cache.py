@@ -78,12 +78,22 @@ def plan_cache_key(plan) -> Optional[tuple]:
 
 
 class ResultCache(SnapshotLRU):
-    """Host-side result cache over the shared snapshot-validated LRU."""
+    """Host-side result cache over the shared snapshot-validated LRU.
+
+    Bounded two ways: a byte budget AND an entry-count capacity (the
+    reference's `CacheConfig.capacity`, enforced here — gap G7 closed).
+    Dashboards repeat a few hundred distinct queries; past that, extra
+    entries are churn that slows every snapshot sweep. Entry-capacity
+    evictions bump `result_cache.evicted` (byte-budget ones
+    `result_cache.evict`)."""
 
     counter_prefix = "result_cache"
 
-    def __init__(self, budget_bytes: int = 256 << 20):
-        super().__init__(budget_bytes)
+    DEFAULT_CAPACITY = 512
+
+    def __init__(self, budget_bytes: int = 256 << 20,
+                 capacity: Optional[int] = DEFAULT_CAPACITY):
+        super().__init__(budget_bytes, capacity=capacity)
 
     def get(self, key: tuple) -> Optional[pa.Table]:  # type: ignore[override]
         digest, _tables, snaps = key

@@ -17,6 +17,11 @@ visitors (docs/static_analysis.md has the rule catalog):
 - ``cache-key``       identity (``id()``) tokens, ``hash()`` over mutable
                       state, and dict/set iteration order feeding cache or
                       jit keys — the PR-2 staleness bug class;
+- ``jit-key``         raw data-dependent ints (live counts, device-get
+                      readbacks, ``int()`` casts) flowing into ``_jitted``
+                      fingerprints — the compile-cache fragmentation class
+                      the cold-start work (docs/compile_cache.md) exists to
+                      kill; quantize through exec/capacity.py first;
 - ``lock-discipline`` every access to state a module declares via
                       ``_GUARDED_BY`` must hold the declared lock (or sit in
                       a caller-locked method);
@@ -131,10 +136,11 @@ def iter_package_files(root: Path = PACKAGE_ROOT) -> list[Path]:
 
 def default_checkers() -> list:
     from igloo_tpu.lint.cache_key import CacheKeyChecker
+    from igloo_tpu.lint.jit_key import JitKeyChecker
     from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
     from igloo_tpu.lint.metric_names import MetricNamesChecker
     from igloo_tpu.lint.sync_hazard import SyncHazardChecker
-    return [SyncHazardChecker(), CacheKeyChecker(),
+    return [SyncHazardChecker(), CacheKeyChecker(), JitKeyChecker(),
             LockDisciplineChecker(), MetricNamesChecker()]
 
 

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Two-process persistent-compile-cache smoke (run by scripts/validate.sh).
+
+Process 1 runs a small query against a FRESH cache directory (true cold:
+every program compiles and persists). Process 2 re-runs the same query in a
+new interpreter and must serve its compiles from disk: `compile_cache.hit`
+> 0 and no cache misses beyond process-startup noise. Wall times print for
+the record; the assertion is on the counters (wall is too noisy on shared
+CI hosts to gate on).
+
+Exit 0 = cache works end to end; exit 1 with a diagnosis otherwise.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import json, time
+t0 = time.perf_counter()
+import jax
+jax.config.update("jax_platforms", "cpu")
+import igloo_tpu
+from igloo_tpu.engine import QueryEngine
+import igloo_tpu.engine as E
+E.DEFAULT_MESH = None
+import pyarrow as pa
+eng = QueryEngine()
+n = 4096
+eng.register_table("t", pa.table({
+    "a": pa.array(range(n), type=pa.int64()),
+    "k": pa.array([i % 11 for i in range(n)], type=pa.int64())}))
+t1 = time.perf_counter()
+eng.execute("SELECT k, SUM(a) AS s, COUNT(*) AS c FROM t "
+            "WHERE a >= 7 GROUP BY k ORDER BY k")
+from igloo_tpu.utils import tracing
+c = tracing.counters()
+print(json.dumps({"hit": c.get("compile_cache.hit", 0),
+                  "miss": c.get("compile_cache.miss", 0),
+                  "startup_s": round(t1 - t0, 3),
+                  "query_s": round(time.perf_counter() - t1, 3)}))
+"""
+
+
+def run_child(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               IGLOO_TPU_COMPILE_CACHE=cache_dir,
+               IGLOO_TPU_COMPILE_CACHE_MIN_SECS="0")
+    t0 = time.perf_counter()
+    out = subprocess.run([sys.executable, "-c", _CHILD], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=300)
+    if out.returncode != 0:
+        print(out.stderr[-2000:], file=sys.stderr)
+        raise SystemExit("compile-cache smoke: child process failed")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    rec["wall_s"] = round(time.perf_counter() - t0, 3)
+    return rec
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="igloo_cc_smoke_") as d:
+        cold = run_child(d)
+        print(f"cold:  {cold}")
+        if cold["miss"] == 0:
+            print("compile-cache smoke: cold run recorded no cache misses — "
+                  "is the persistent cache actually enabled?",
+                  file=sys.stderr)
+            return 1
+        warm = run_child(d)
+        print(f"warm:  {warm}")
+        if warm["hit"] == 0:
+            print("compile-cache smoke: second process got ZERO cache hits — "
+                  "persistent entries were not written or not read",
+                  file=sys.stderr)
+            return 1
+    print("compile-cache smoke: OK "
+          f"(cold query {cold['query_s']}s / {cold['miss']} misses, "
+          f"warm query {warm['query_s']}s / {warm['hit']} hits)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,9 @@ fi
 echo "== 2-worker shuffle-join smoke (fragment-tier exchange) =="
 python scripts/shuffle_smoke.py
 
+echo "== persistent compile-cache smoke (two-process cold/warm) =="
+python scripts/compile_cache_smoke.py
+
 echo "== pytest (fast tier, virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q -m "not slow"
 

@@ -1,0 +1,30 @@
+"""jit-key fixture: raw data-dependent ints reaching _jitted fingerprints."""
+import jax
+import jax.numpy as jnp
+
+
+class Ex:
+    def _jitted(self, kind, fp, build):
+        return build()
+
+    def inline_source(self, batch, build):
+        return self._jitted("compact", ("compact", batch.num_live()), build)  # BAD
+
+    def tainted_name(self, batch, build):
+        n = int(jnp.sum(batch.live))
+        fp = ("agg", n)
+        return self._jitted("agg", fp, build)  # BAD
+
+    def via_device_get(self, dev, build):
+        total = jax.device_get(dev)
+        key = ("join", int(total))
+        return self._jitted("join", key, build)  # BAD
+
+    def arithmetic_wrap(self, batch, build):
+        n = batch.num_live()
+        cap = max(n, 1) * 2
+        return self._jitted("sort", ("sort", cap), build)  # BAD
+
+    def suppressed(self, batch, build):
+        # justified one-off: documented rationale would go here
+        return self._jitted("x", ("x", batch.num_live()), build)  # lint: allow(jit-key)

@@ -1,0 +1,44 @@
+"""jit-key fixture twin: quantized / shape-class fingerprints only."""
+import jax.numpy as jnp
+
+
+def round_capacity(n):
+    return n
+
+
+def canonical_direct_table(lo, hi):
+    return lo, hi
+
+
+def batch_proto_key(batch):
+    return batch.schema
+
+
+class Ex:
+    def _jitted(self, kind, fp, build):
+        return build()
+
+    def sanitized_count(self, batch, build):
+        n = batch.num_live()
+        want = round_capacity(max(n, 1))
+        return self._jitted("compact", ("compact", want), build)
+
+    def prototype_key(self, batch, build):
+        fp = ("filter", batch_proto_key(batch), batch.capacity)
+        return self._jitted("filter", fp, build)
+
+    def canonical_table(self, bounds, build):
+        blo, tsize = canonical_direct_table(int(bounds[0]), int(bounds[1]))
+        return self._jitted("join_direct", ("jd", blo, tsize), build)
+
+    def passthrough(self, kind, fingerprint, build):
+        # parameters are out of scope for the function-local analysis
+        return self._jitted(kind, fingerprint, build)
+
+    def plan_constant(self, plan, batch, build):
+        fp = ("limit", plan.limit, plan.offset)
+        return self._jitted("limit", fp, build)
+
+    def cast_of_sanitized(self, batch, build):
+        want = int(round_capacity(batch.num_live()))
+        return self._jitted("compact", ("compact", want), build)

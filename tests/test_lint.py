@@ -6,6 +6,7 @@ from pathlib import Path
 
 from igloo_tpu.lint import LintModule, iter_package_files, run_lint
 from igloo_tpu.lint.cache_key import CacheKeyChecker
+from igloo_tpu.lint.jit_key import JitKeyChecker
 from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
 from igloo_tpu.lint.metric_names import MetricNamesChecker
 from igloo_tpu.lint.sync_hazard import SyncHazardChecker
@@ -76,6 +77,21 @@ def test_lock_discipline_ignores_undeclared_modules():
     # no _GUARDED_BY -> nothing checked, even with bare lock usage
     f = _lint([PKG / "cache_key_clean.py"], [LockDisciplineChecker()])
     assert f == []
+
+
+# --- jit-key ----------------------------------------------------------------
+
+def test_jit_key_flags_bad_fixture():
+    f = _lint([PKG / "jit_key_bad.py"], [JitKeyChecker()])
+    lines = {x.line for x in f}
+    assert all(x.rule == "jit-key" for x in f)
+    src = (PKG / "jit_key_bad.py").read_text().splitlines()
+    bad_lines = {i for i, ln in enumerate(src, 1) if "# BAD" in ln}
+    assert lines == bad_lines, (sorted(lines), sorted(bad_lines))
+
+
+def test_jit_key_passes_clean_fixture():
+    assert _lint([PKG / "jit_key_clean.py"], [JitKeyChecker()]) == []
 
 
 # --- metric-names -----------------------------------------------------------
