@@ -3,7 +3,13 @@
 Fills two reference stubs at once: `crates/client/src/main.rs:1-4` (an empty
 binary that was meant to speak Flight SQL) and `pyigloo` (an empty PyO3 crate).
 Any stock Arrow Flight client interoperates — this class is convenience, not
-protocol: `flight.connect(addr).do_get(ticket=sql)` works from any language.
+protocol: a stock client's `do_get(ticket=sql)` works from any language.
+
+Every call carries the RPC policy's per-call deadline, so a hung coordinator
+costs a bounded timeout instead of a wedged client; pass `deadline_s` to
+`execute` for a per-query budget the COORDINATOR also enforces (it stops
+dispatching fragments and releases worker results at the deadline), and
+`qid` to make the query addressable by `cancel`.
 """
 from __future__ import annotations
 
@@ -13,17 +19,17 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.flight as flight
 
+from igloo_tpu.cluster import rpc
+from igloo_tpu.cluster.rpc import call_options as _call_options
+from igloo_tpu.cluster.rpc import normalize as _normalize
 from igloo_tpu.errors import IglooError
 
 
-from igloo_tpu.cluster.rpc import call_options as _call_options
-from igloo_tpu.cluster.rpc import normalize as _normalize
-
-
 class DistributedClient:
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, policy: Optional[rpc.RpcPolicy] = None):
         self.addr = _normalize(addr)
-        self._client = flight.connect(self.addr)
+        self._policy = policy or rpc.default_policy()
+        self._client = rpc.connect(self.addr)
 
     # --- health / metadata ---
 
@@ -43,23 +49,45 @@ class DistributedClient:
 
     # --- queries ---
 
-    def execute(self, sql: str) -> pa.Table:
-        """One round trip: the ticket IS the SQL (do_get executes once)."""
+    def execute(self, sql: str, deadline_s: Optional[float] = None,
+                qid: Optional[str] = None) -> pa.Table:
+        """One round trip: the ticket IS the SQL (do_get executes once).
+        `deadline_s` bounds the query server-side (and this call, slightly
+        padded so the coordinator's deadline fires first and reports
+        properly); `qid` names it for `cancel`."""
+        ticket = sql
+        if deadline_s is not None or qid is not None:
+            body = {"sql": sql}
+            if deadline_s is not None:
+                body["deadline_s"] = deadline_s
+            if qid is not None:
+                body["qid"] = qid
+            ticket = json.dumps(body)
+        timeout = self._policy.stream_timeout_s if deadline_s is None \
+            else deadline_s + min(5.0, self._policy.connect_timeout_s)
         try:
-            reader = self._client.do_get(flight.Ticket(sql.encode()),
-                                         _call_options())
+            reader = self._client.do_get(flight.Ticket(ticket.encode()),
+                                         _call_options(timeout_s=timeout))
             return reader.read_all()
         except flight.FlightError as ex:
             raise IglooError(_strip_flight(str(ex))) from None
 
     sql = execute
 
+    def cancel(self, qid: str) -> bool:
+        """Cancel a running distributed query by the qid passed to
+        `execute`; False when the coordinator no longer knows it."""
+        return bool(self._action("cancel_query",
+                                 {"qid": qid}).get("cancelled"))
+
     def schema(self, sql: str) -> pa.Schema:
         """Result schema WITHOUT executing (the reference runs the query to
         answer this — crates/api/src/lib.rs:90-98)."""
         desc = flight.FlightDescriptor.for_command(sql.encode())
         try:
-            return self._client.get_schema(desc, _call_options()).schema
+            return self._client.get_schema(
+                desc, _call_options(
+                    timeout_s=self._policy.call_timeout_s)).schema
         except flight.FlightError as ex:
             raise IglooError(_strip_flight(str(ex))) from None
 
@@ -68,7 +96,9 @@ class DistributedClient:
     def register_table(self, name: str, table: pa.Table) -> None:
         """Upload an in-memory table (Flight do_put; reference: unimplemented)."""
         desc = flight.FlightDescriptor.for_path(name)
-        writer, _ = self._client.do_put(desc, table.schema, _call_options())
+        writer, _ = self._client.do_put(
+            desc, table.schema,
+            _call_options(timeout_s=self._policy.stream_timeout_s))
         writer.write_table(table)
         writer.close()
 
@@ -88,8 +118,9 @@ class DistributedClient:
     def _action(self, name: str, payload: Optional[dict] = None) -> dict:
         body = json.dumps(payload).encode() if payload is not None else b""
         try:
-            results = list(self._client.do_action(flight.Action(name, body),
-                                                  _call_options()))
+            results = list(self._client.do_action(
+                flight.Action(name, body),
+                _call_options(timeout_s=self._policy.call_timeout_s)))
         except flight.FlightError as ex:
             raise IglooError(_strip_flight(str(ex))) from None
         return json.loads(results[0].body.to_pybytes()) if results else {}

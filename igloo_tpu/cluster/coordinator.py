@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import json
+import os
 import threading
 import time
 import uuid
@@ -35,12 +36,28 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.flight as flight
 
-from igloo_tpu.cluster import rpc, serde
+from igloo_tpu.cluster import faults, rpc, serde
 from igloo_tpu.cluster.fragment import DistributedPlanner, QueryFragment
 from igloo_tpu.cluster.rpc import flight_action
 from igloo_tpu.engine import QueryEngine
-from igloo_tpu.errors import IglooError
-from igloo_tpu.utils import tracing
+from igloo_tpu.errors import (
+    DeadlineExceededError, IglooError, QueryCancelledError,
+)
+from igloo_tpu.utils import stats, tracing
+
+#: default per-query deadline (seconds) for the distributed path; unset or
+#: <= 0 = unbounded. Precedence: per-call override > this env var > [rpc]
+#: query_deadline_s config (env beats config, like every other [rpc] knob).
+#: A PER-CALL deadline_s of 0 is different: it is an already-spent budget
+#: and expires the query immediately (matching rpc.call_options, where a
+#: deadline in the past still produces DEADLINE_EXCEEDED, not "no deadline")
+QUERY_DEADLINE_ENV = "IGLOO_QUERY_DEADLINE_S"
+
+#: how long recovery waits for SOME worker to (re-)register when every
+#: worker is momentarily unreachable — a rolling restart or a flaky blip
+#: that evicted the whole fleet should stall the query briefly, not fail it
+#: (bounded by the query deadline when one is set)
+RECOVER_WAIT_S = 5.0
 
 
 @dataclass
@@ -106,17 +123,52 @@ class Membership:
         return None
 
 
+class CancelToken:
+    """Cooperative per-query cancellation flag, checked between fragment
+    waves, before each dispatch, and per relayed batch."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+
+    def cancel(self) -> None:
+        self._ev.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._ev.is_set()
+
+
 class DistributedExecutor:
     """Wave-based fragment scheduler (distributed_executor.rs:36-193 parity,
     with the wire layer real and worker failure handled by re-dispatch:
     fragments are pure functions of their inputs, so losing a worker only
-    costs re-execution of the fragments whose sole result copy it held)."""
+    costs re-execution of the fragments whose sole result copy it held).
+
+    Failure budget: every query runs under an optional DEADLINE (per-call
+    override > constructor default > IGLOO_QUERY_DEADLINE_S > [rpc]
+    query_deadline_s) and a
+    CancelToken registered under its qid (the `cancel_query` Flight action).
+    Hung-worker detection is deadline-driven: a dispatch that exceeds its
+    per-call RPC deadline is a dead-worker signal and enters the `_recover`
+    re-dispatch path — a worker that accepts TCP but never answers costs one
+    bounded timeout, not a wedged query. A cancelled or over-deadline query
+    releases its FragmentStore results and stops dispatching instead of
+    running to completion."""
 
     def __init__(self, membership: Membership, max_parallel: int = 16,
-                 max_recoveries: int = 8):
+                 max_recoveries: int = 8,
+                 rpc_policy: Optional[rpc.RpcPolicy] = None,
+                 default_deadline_s: Optional[float] = None):
         self.membership = membership
         self.max_parallel = max_parallel
         self.max_recoveries = max_recoveries
+        self.rpc_policy = rpc_policy   # None -> rpc.default_policy() per call
+        if default_deadline_s is None:
+            env = os.environ.get(QUERY_DEADLINE_ENV)
+            default_deadline_s = float(env) if env else None
+        if default_deadline_s is not None and default_deadline_s <= 0:
+            default_deadline_s = None  # "0" = explicitly unbounded
+        self.default_deadline_s = default_deadline_s
         # per-fragment metrics of the most recent query: the working version
         # of the reference's never-populated QueryComplete{total_rows,
         # execution_time_ms} (distributed.proto:66-69, SURVEY §5.5)
@@ -126,44 +178,90 @@ class DistributedExecutor:
         # `metrics` Flight action exports as labeled Prometheus series
         self.worker_totals: dict = {}
         self._totals_lock = threading.Lock()
+        # in-flight queries by qid -> CancelToken (cancel_query targets)
+        self._queries: dict[str, CancelToken] = {}
+        self._queries_lock = threading.Lock()
 
-    def execute(self, fragments: list[QueryFragment]) -> pa.Table:
-        schema, gen = self.execute_stream(fragments)
+    def _policy(self) -> rpc.RpcPolicy:
+        return self.rpc_policy or rpc.default_policy()
+
+    def cancel(self, qid: str) -> bool:
+        """Flip a running query's cancel token; False if qid is unknown
+        (already finished, or never existed)."""
+        with self._queries_lock:
+            tok = self._queries.get(qid)
+        if tok is None:
+            return False
+        tok.cancel()
+        return True
+
+    def active_queries(self) -> list[str]:
+        with self._queries_lock:
+            return list(self._queries)
+
+    def execute(self, fragments: list[QueryFragment],
+                deadline_s: Optional[float] = None,
+                qid: Optional[str] = None, sql: str = "") -> pa.Table:
+        schema, gen = self.execute_stream(fragments, deadline_s=deadline_s,
+                                          qid=qid, sql=sql)
         return pa.Table.from_batches(list(gen), schema=schema)
 
-    def execute_stream(self, fragments: list[QueryFragment]
+    def execute_stream(self, fragments: list[QueryFragment],
+                       deadline_s: Optional[float] = None,
+                       qid: Optional[str] = None, sql: str = ""
                        ) -> tuple[pa.Schema, object]:
         """Run the fragment waves, then return (schema, batch generator)
         streaming the root result from its worker — the coordinator never
         holds more than one in-flight batch of a distributed result. The
         generator publishes per-query metrics and releases worker-held
-        fragment results when it is exhausted (or closed)."""
+        fragment results when it is exhausted (or closed). Cancellation and
+        the deadline are checked between waves, before every dispatch, and
+        per relayed batch."""
         frags = {f.id: f for f in fragments}
         root_id = fragments[-1].id
         completed: dict[str, str] = {}  # frag id -> worker addr holding result
         pending = set(frags)
         recoveries = 0
         t_start = time.time()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        # `is not None`, not truthy: a per-call deadline_s of 0 is a spent
+        # budget and must expire the query NOW, not run it unbounded
+        deadline = t_start + deadline_s if deadline_s is not None else None
+        qid = qid or uuid.uuid4().hex[:12]
+        token = CancelToken()
+        with self._queries_lock:
+            self._queries[qid] = token
         # per-QUERY metrics dict: concurrent queries each build their own and
-        # publish atomically at the end (last_metrics = last completed query).
+        # publish atomically at the end (last_metrics = last finished query).
         # Per-fragment entries attribute wall time to dispatch (RPC + queue)
         # vs execute (worker-reported) vs dep_fetch (peer transfers); the
         # query-level recover_s/fetch_s cover re-dispatch and the root fetch.
-        metrics: dict = {"fragments": [], "recoveries": 0,
-                         "recover_s": 0.0, "fetch_s": 0.0}
+        metrics: dict = {"qid": qid, "fragments": [], "recoveries": 0,
+                         "recover_s": 0.0, "fetch_s": 0.0, "status": "ok",
+                         "deadline_s": deadline_s,
+                         "cancelled": False, "deadline_exceeded": False,
+                         # every addr a fragment was EVER dispatched to
+                         # (set.add is atomic under the GIL; "_"-prefixed
+                         # keys never publish): _recover reassigns
+                         # frags[fid].worker, so release must remember the
+                         # evicted addr too — its handler may still be
+                         # running and needs the tombstone
+                         "_addrs": set()}
         shuffle_buckets = {f.bucket for f in fragments
                           if f.bucket is not None}
         metrics["shuffle_buckets"] = len(shuffle_buckets)
         try:
             with cf.ThreadPoolExecutor(self.max_parallel) as pool:
                 while pending:
+                    self._check_query(token, deadline, metrics)
                     ready = [frags[fid] for fid in pending
                              if frags[fid].is_ready(set(completed))]
                     if not ready:
                         raise IglooError(
                             "circular dependency in fragment graph")
                     futs = {pool.submit(self._dispatch, f, dict(completed),
-                                        metrics): f
+                                        metrics, deadline, token): f
                             for f in ready}
                     dead: set[str] = set()
                     lost_deps: set[str] = set()
@@ -185,20 +283,28 @@ class DistributedExecutor:
                         dead.add(completed.get(dep_id, ""))
                     if dead:
                         recoveries += 1
+                        metrics["recoveries"] = recoveries
                         if recoveries > self.max_recoveries:
                             raise IglooError(
                                 "giving up after repeated worker failures")
+                        # no budget left: report the deadline, don't burn the
+                        # remaining workers on a recovery that cannot finish
+                        self._check_query(token, deadline, metrics)
                         t_rec = time.perf_counter()
-                        self._recover(dead, frags, completed, pending)
+                        self._recover(dead, frags, completed, pending,
+                                      deadline)
                         metrics["recover_s"] += time.perf_counter() - t_rec
             # open the root stream eagerly: the schema the worker reports is
             # authoritative, and a root holder lost between the last wave and
             # here surfaces now, while the caller can still see the error
             t_fetch = time.perf_counter()
             schema, batch_iter = rpc.flight_stream_batches(
-                completed[root_id], root_id)
-        except BaseException:
-            self._release(frags, completed, list(frags))
+                completed[root_id], root_id, policy=self._policy(),
+                deadline=deadline)
+        except BaseException as ex:
+            self._release(frags, completed, list(frags),
+                          metrics["_addrs"])
+            self._finalize(qid, metrics, t_start, sql, error=ex, token=token)
             raise
 
         done = [False]
@@ -217,29 +323,32 @@ class DistributedExecutor:
                     close()  # drop the root worker's Flight connection
                 except Exception:
                     pass
-            self._release(frags, completed, list(frags))
+            self._release(frags, completed, list(frags),
+                          metrics["_addrs"])
+            self._unregister(qid, token)
 
         def gen():
             total_rows = 0
             try:
                 for batch in batch_iter:
+                    # over-deadline / cancelled mid-relay: stop streaming,
+                    # release worker results (cleanup in finally)
+                    self._check_query(token, deadline, metrics)
                     total_rows += batch.num_rows
                     yield batch
                 metrics["fetch_s"] = round(time.perf_counter() - t_fetch, 6)
-                # dedupe by fragment id (a fragment re-run after a worker
-                # death appends twice; last execution wins)
-                by_id: dict = {}
-                for info in metrics["fragments"]:
-                    by_id[info.get("id", len(by_id))] = info
-                metrics["fragments"] = list(by_id.values())
-                metrics.update(
-                    total_rows=total_rows, recoveries=recoveries,
-                    recover_s=round(metrics["recover_s"], 6),
-                    exchange_bytes=sum(i.get("exchange_bytes") or 0
-                                       for i in metrics["fragments"]),
-                    execution_time_s=round(time.time() - t_start, 6))
-                self.last_metrics = metrics  # atomic publish
-                self._accumulate(metrics)
+                metrics["total_rows"] = total_rows
+                metrics["recoveries"] = recoveries
+                self._finalize(qid, metrics, t_start, sql, completed=True,
+                               token=token)
+            except BaseException as ex:
+                if isinstance(ex, GeneratorExit):
+                    # consumer closed the stream early: released, not logged
+                    self._finalize(qid, metrics, t_start, sql, token=token)
+                else:
+                    self._finalize(qid, metrics, t_start, sql, error=ex,
+                                   token=token)
+                raise
             finally:
                 cleanup()
         g = gen()
@@ -248,16 +357,115 @@ class DistributedExecutor:
 
     # --- internals ---
 
+    def _check_query(self, token: CancelToken, deadline: Optional[float],
+                     metrics: dict) -> None:
+        """Raise if the query was cancelled or its deadline passed (flags
+        recorded in the per-query metrics; counters bump once, at finalize)."""
+        if token.cancelled:
+            metrics["cancelled"] = True
+            raise QueryCancelledError(f"query {metrics['qid']} cancelled")
+        if deadline is not None and time.time() >= deadline:
+            metrics["deadline_exceeded"] = True
+            raise DeadlineExceededError(
+                f"query {metrics['qid']} exceeded its "
+                f"{metrics['deadline_s']}s deadline")
+
+    def _unregister(self, qid: str, token: CancelToken) -> None:
+        """Drop the qid -> CancelToken registration ONLY if it is still this
+        query's token: a client that reuses a qid overwrites the slot with
+        the NEWER query's token, and the older query's late finalize/cleanup
+        must not evict it — that would leave the live query uncancellable
+        and invisible to active_queries()."""
+        with self._queries_lock:
+            if self._queries.get(qid) is token:
+                del self._queries[qid]
+
+    def _finalize(self, qid: str, metrics: dict, t_start: float, sql: str,
+                  error: Optional[BaseException] = None,
+                  completed: bool = False,
+                  token: Optional[CancelToken] = None) -> None:
+        """Publish a finished query exactly once: last_metrics + cumulative
+        worker totals + a system.query_log row (status ok / cancelled /
+        deadline_exceeded / error). Called with neither `completed` nor
+        `error` (an abandoned stream) it only unregisters the qid — the
+        results were released, but nothing finished to report."""
+        if token is not None:
+            self._unregister(qid, token)
+        with self._queries_lock:
+            if metrics.get("_finalized"):
+                return
+            metrics["_finalized"] = True
+        if error is None and not completed:
+            return
+        status = "ok"
+        if isinstance(error, QueryCancelledError) or metrics["cancelled"]:
+            status = "cancelled"
+            tracing.counter("query.cancelled")
+        elif isinstance(error, DeadlineExceededError) or \
+                metrics["deadline_exceeded"]:
+            # covers both the wave/relay checks and an rpc-layer
+            # DeadlineExceededError raised mid-call
+            status = "deadline_exceeded"
+            metrics["deadline_exceeded"] = True
+            tracing.counter("query.deadline_exceeded")
+        elif error is not None:
+            status = "error"
+        metrics["status"] = status
+        # dedupe by fragment id (a fragment re-run after a worker death
+        # appends twice; last execution wins)
+        by_id: dict = {}
+        for info in metrics["fragments"]:
+            by_id[info.get("id", len(by_id))] = info
+        metrics["fragments"] = list(by_id.values())
+        metrics.update(
+            recover_s=round(metrics["recover_s"], 6),
+            exchange_bytes=sum(i.get("exchange_bytes") or 0
+                               for i in metrics["fragments"]),
+            execution_time_s=round(time.time() - t_start, 6))
+        pub = {k: v for k, v in metrics.items() if not k.startswith("_")}
+        self.last_metrics = pub  # atomic publish
+        self._accumulate(pub)
+        stats.log_query(sql, elapsed_s=pub["execution_time_s"],
+                        tier="distributed", rows=pub.get("total_rows"),
+                        status=status, started_at=t_start)
+
     def _live_addrs(self) -> list[str]:
         return [w.addr for w in self.membership.live()]
 
     def _dispatch(self, f: QueryFragment, completed: dict[str, str],
-                  metrics: dict) -> None:
+                  metrics: dict, deadline: Optional[float] = None,
+                  token: Optional[CancelToken] = None) -> None:
+        if token is not None and token.cancelled:
+            raise QueryCancelledError("query cancelled")
+        # remember the target BEFORE the call: a timed-out dispatch keeps
+        # running server-side, and end-of-query release must reach this addr
+        # even after _recover reassigns the fragment elsewhere
+        metrics["_addrs"].add(f.worker)
         req = {"id": f.id, "plan": f.plan,
                "deps": [{"id": d, "addr": completed[d]} for d in f.deps]}
+        rem = rpc.remaining_s(deadline)
+        if rem is not None:
+            # ship the remaining budget as a RELATIVE bound (clocks differ
+            # across machines): the worker uses it to deadline its own peer
+            # dep-fetches so a hung peer can't wedge the fragment either
+            req["timeout_s"] = round(max(rem, 0.001), 3)
+        pol = self._policy()
         try:
             t0 = time.perf_counter()
-            info = flight_action(f.worker, "execute_fragment", req)
+            # retries=0: re-dispatch is the RECOVERY layer's job — an RPC-
+            # level retry against the same hung worker would just double the
+            # time a dead worker stalls the wave. The per-dispatch bound is
+            # the HANG DETECTOR: under a query deadline it is call_timeout_s
+            # (clamped to the remaining budget) so rescue fits inside the
+            # deadline; without one, a dispatch runs QUERY work and gets the
+            # stream budget instead — a slow-but-legitimate fragment must
+            # not be misread as a hung worker at the control-action timeout
+            info = flight_action(f.worker, "execute_fragment", req,
+                                 policy=pol.with_(retries=0),
+                                 deadline=deadline,
+                                 timeout_s=(pol.call_timeout_s
+                                            if deadline is not None
+                                            else pol.stream_timeout_s))
             wall = time.perf_counter() - t0
             info["addr"] = f.worker
             if f.kind:
@@ -271,6 +479,10 @@ class DistributedExecutor:
                 wall - info.get("elapsed_s", 0.0)
                 - info.get("dep_fetch_s", 0.0), 0.0), 6)
             metrics["fragments"].append(info)
+        except flight.FlightUnauthenticatedError:
+            raise  # fatal by classification: never a dead-worker signal
+        except DeadlineExceededError:
+            raise  # query budget spent before the call could start
         except flight.FlightServerError as ex:
             marker = "DEP_UNAVAILABLE:"
             msg = str(ex)
@@ -278,12 +490,22 @@ class DistributedExecutor:
                 dep_id = msg.split(marker, 1)[1].split()[0]
                 raise _DepLost(dep_id)
             raise  # execution error on a live worker: surface it
-        except Exception:
-            raise _WorkerDied(f.worker)
+        except Exception as ex:
+            # only RETRYABLE failures are a dead-worker signal:
+            # FlightTimedOutError (the hung worker — accepted TCP, never
+            # answered), FlightUnavailableError, connection errors. Anything
+            # rpc.retryable() calls fatal (internal/cancelled/unknown Flight
+            # errors) is a real failure a HEALTHY worker reported —
+            # re-dispatching it would evict worker after worker and bury the
+            # actual error under "repeated worker failures"
+            if rpc.retryable(ex):
+                raise _WorkerDied(f.worker)
+            raise
         tracing.counter("coordinator.fragments_dispatched")
 
     def _recover(self, dead_addrs: set[str], frags: dict[str, QueryFragment],
-                 completed: dict[str, str], pending: set) -> None:
+                 completed: dict[str, str], pending: set,
+                 deadline: Optional[float] = None) -> None:
         """Evict dead workers, requeue results they held, move their work."""
         import itertools
         for addr in dead_addrs:
@@ -291,6 +513,19 @@ class DistributedExecutor:
             if w is not None:
                 self.membership.evict(w.worker_id)
         live = self._live_addrs()
+        if not live:
+            # the whole fleet is momentarily unreachable (rolling restart, a
+            # blip that tripped every dispatch at once): evicted-but-alive
+            # workers re-register on their next heartbeat — wait for one
+            # instead of failing the query instantly
+            wait = RECOVER_WAIT_S
+            rem = rpc.remaining_s(deadline)
+            if rem is not None:
+                wait = min(wait, max(rem, 0.0))
+            t_end = time.time() + wait
+            while not live and time.time() < t_end:
+                time.sleep(0.05)
+                live = self._live_addrs()
         if not live:
             raise IglooError(
                 f"no live workers left (failed: {sorted(dead_addrs)})")
@@ -355,14 +590,23 @@ class DistributedExecutor:
         return lines
 
     def _release(self, frags: dict[str, QueryFragment],
-                 completed: dict[str, str], ids: list[str]) -> None:
-        # every worker a fragment was ASSIGNED to, not just recorded holders:
-        # a wave that errored out mid-collection leaves results on workers
-        # whose completions were never processed
-        addrs = set(completed.values()) | {f.worker for f in frags.values()}
+                 completed: dict[str, str], ids: list[str],
+                 dispatched=()) -> None:
+        # every worker a fragment was ASSIGNED to or EVER dispatched to, not
+        # just recorded holders: a wave that errored out mid-collection
+        # leaves results on workers whose completions were never processed,
+        # and an EVICTED worker (its fragment reassigned by _recover) may
+        # still be running the timed-out handler — it needs the release so
+        # its store grows a tombstone for the late put
+        addrs = set(completed.values()) | \
+            {f.worker for f in frags.values()} | set(dispatched)
         for addr in addrs:
             try:
-                flight_action(addr, "release", {"ids": ids})
+                # short bound, no retries: release is best-effort cleanup and
+                # often targets the very worker that just died
+                flight_action(addr, "release", {"ids": ids},
+                              policy=self._policy().with_(retries=0),
+                              timeout_s=10.0)
             except Exception:
                 pass  # worker gone; nothing to release
 
@@ -393,6 +637,8 @@ class CoordinatorServer(flight.FlightServerBase):
             kw.setdefault("auth_handler", ah)
         rpc.warn_if_open_bind(location.split("://")[-1].rsplit(":", 1)[0],
                               "coordinator")
+        # pick up IGLOO_FAULTS set after import (in-process test clusters)
+        faults.refresh()
         super().__init__(location, **kw)
         if advertise_host is None:
             # endpoint host clients are told to come back to: the bound host
@@ -444,10 +690,15 @@ class CoordinatorServer(flight.FlightServerBase):
 
     # --- query execution ---
 
-    def execute_sql(self, sql: str, stream: bool = False):
+    def execute_sql(self, sql: str, stream: bool = False,
+                    deadline_s: Optional[float] = None,
+                    qid: Optional[str] = None):
         """-> pa.Table, or — for `stream=True` on the distributed path —
         (pa.Schema, record-batch generator) so do_get can relay the root
-        worker's stream batch-wise instead of materializing it here."""
+        worker's stream batch-wise instead of materializing it here.
+        `deadline_s`/`qid` bound + name the DISTRIBUTED execution (deadline,
+        cancel_query); the local fallback paths run synchronously in-process
+        and are not cancellable mid-flight."""
         live = self.membership.live()
         if not live:
             # a coordinator with no workers is still a working single-node
@@ -477,8 +728,10 @@ class CoordinatorServer(flight.FlightServerBase):
         frags = planner.plan(plan)
         tracing.counter("coordinator.distributed_queries")
         if stream:
-            return self.executor.execute_stream(frags)
-        return self.executor.execute(frags)
+            return self.executor.execute_stream(frags, deadline_s=deadline_s,
+                                                qid=qid, sql=sql)
+        return self.executor.execute(frags, deadline_s=deadline_s, qid=qid,
+                                     sql=sql)
 
     def _distributable(self, plan) -> bool:
         from igloo_tpu.plan.logical import Scan, walk_plan
@@ -500,8 +753,15 @@ class CoordinatorServer(flight.FlightServerBase):
     # --- Flight methods (full surface; reference implements 2 of 9) ---
 
     def do_action(self, context, action):
+        faults.inject(f"coordinator.do_action.{action.type}")
         body = action.body.to_pybytes() if action.body is not None else b""
         req = json.loads(body) if body else {}
+        if action.type == "cancel_query":
+            ok = self.executor.cancel(req.get("qid", ""))
+            return [json.dumps({"cancelled": ok}).encode()]
+        if action.type == "active_queries":
+            return [json.dumps(
+                {"queries": self.executor.active_queries()}).encode()]
         if action.type == "register_worker":
             self.membership.register(req["id"], req["addr"])
             w = self.membership.by_addr(req["addr"])
@@ -569,7 +829,9 @@ class CoordinatorServer(flight.FlightServerBase):
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
-        return [("register_worker", "worker membership registration "
+        return [("cancel_query", "cancel a running distributed query by qid"),
+                ("active_queries", "qids of in-flight distributed queries"),
+                ("register_worker", "worker membership registration "
                                     "(returns compile-cache setting + "
                                     "entry listing for pre-warm)"),
                 ("compile_cache_get",
@@ -600,17 +862,41 @@ class CoordinatorServer(flight.FlightServerBase):
             self._descriptor_sql(descriptor)))
 
     def do_get(self, context, ticket):
-        sql = ticket.ticket.decode()
+        faults.inject("coordinator.do_get")
+        raw = ticket.ticket.decode()
+        sql, deadline_s, qid = raw, None, None
+        if raw.lstrip().startswith("{"):
+            # extended ticket: {"sql": ..., "deadline_s": ..., "qid": ...}
+            # (SQL cannot start with "{", so plain-SQL tickets keep working)
+            try:
+                d = json.loads(raw)
+                sql = d["sql"]
+                if not isinstance(sql, str):
+                    raise TypeError("sql must be a string")
+                deadline_s = d.get("deadline_s")
+                if deadline_s is not None:
+                    # coerce HERE so a mistyped field ("5" or [5]) is a
+                    # "bad query ticket" error, not a TypeError surfacing
+                    # as an opaque gRPC internal error mid-execute
+                    deadline_s = float(deadline_s)
+                qid = d.get("qid")
+                if qid is not None:
+                    qid = str(qid)
+            except (ValueError, KeyError, TypeError):
+                raise flight.FlightServerError(f"bad query ticket: {raw!r}")
         try:
-            out = self.execute_sql(sql, stream=True)
+            out = self.execute_sql(sql, stream=True, deadline_s=deadline_s,
+                                   qid=qid)
         except IglooError as ex:
             raise flight.FlightServerError(str(ex))
         if isinstance(out, tuple):
             # distributed: relay the root worker's stream batch-wise
-            return flight.GeneratorStream(*out)
+            return flight.GeneratorStream(
+                out[0], faults.wrap_stream("coordinator.do_get", out[1]))
         return flight.RecordBatchStream(out)
 
     def do_put(self, context, descriptor, reader, writer):
+        faults.inject("coordinator.do_put")
         name = self._descriptor_table(descriptor)
         table = reader.read_all()
         self.register_table(name, table)
@@ -624,6 +910,7 @@ class CoordinatorServer(flight.FlightServerBase):
           do_put) and the stored table streams back — a round-trip echo a
           stock client can verify; with no uploaded batches the currently
           registered table streams back."""
+        faults.inject("coordinator.do_exchange")
         if descriptor.descriptor_type == flight.DescriptorType.CMD:
             sql = descriptor.command.decode()
             try:
@@ -718,9 +1005,17 @@ def main(argv=None) -> int:
     server = CoordinatorServer(f"grpc+tcp://{args.host}:{args.port}",
                                worker_timeout_s=timeout)
     if args.config:
-        from igloo_tpu.config import Config, make_provider
+        from igloo_tpu.config import Config, make_provider, rpc_policy
         cfg = Config.load(args.config)
         server.membership.timeout_s = cfg.cluster.worker_timeout_s
+        # [rpc] config is the base; IGLOO_RPC_* env still wins per-field
+        rpc.set_default_policy(rpc.policy_from_env(rpc_policy(cfg)))
+        if cfg.rpc.query_deadline_s is not None and \
+                not os.environ.get(QUERY_DEADLINE_ENV):
+            # same precedence as every other [rpc] knob: env beats config;
+            # a configured 0 means explicitly unbounded
+            server.executor.default_deadline_s = \
+                cfg.rpc.query_deadline_s or None
         for t in cfg.tables:
             server.register_table(t.name, make_provider(t))
     print(f"igloo-coordinator serving on grpc+tcp://{args.host}:"

@@ -28,6 +28,7 @@ import json
 import os
 import tempfile
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
@@ -50,7 +51,12 @@ DEFAULT_STORE_BUDGET = 1 << 30
 # hit concurrently by Flight RPC threads (execute_fragment stores, do_get
 # streams, release drops) — every access to the entry map and its spill
 # bookkeeping must hold the store lock or sit in a `*_locked` method
-_GUARDED_BY = {"_lock": ("_entries", "_seq", "_tmpdir")}
+_GUARDED_BY = {"_lock": ("_entries", "_seq", "_tmpdir", "_released")}
+
+#: released-fragment tombstones kept (FIFO): big enough to cover every id a
+#: burst of queries can release while one abandoned execution drags on,
+#: small enough to never matter (ids are 12-byte hex)
+TOMBSTONE_CAP = 4096
 
 
 # --- deterministic key hashing ----------------------------------------------
@@ -186,6 +192,14 @@ class FragmentStore:
         self._lock = threading.Lock()
         self._seq = 0
         self._tmpdir: Optional[str] = None
+        # release tombstones: a dispatch the coordinator timed out (hung
+        # worker) or cancelled keeps RUNNING server-side — gRPC deadlines
+        # cancel the call, not the handler. When it finally finishes, its
+        # `put` must not resurrect a result the query already released (the
+        # coordinator will never release it again -> permanent RSS leak).
+        # Fragment ids are per-query uuids, never reused, so dropping any
+        # put of a released id is always correct.
+        self._released: OrderedDict = OrderedDict()
 
     # --- writes ---
 
@@ -212,7 +226,15 @@ class FragmentStore:
             ent = _Stored(schema=table.schema, batches=batches,
                           nbytes=sum(b.nbytes for b in batches),
                           rows=table.num_rows)
+        # a `__dep_<fid>:...` slice is released alongside fragment <fid>, so
+        # its orphan check keys on the owning fragment id
+        base = frag_id
+        if base.startswith("__dep_"):
+            base = base[len("__dep_"):].split(":", 1)[0]
         with self._lock:
+            if frag_id in self._released or base in self._released:
+                tracing.counter("exchange.orphan_dropped")
+                return ent
             self._seq += 1
             ent.seq = self._seq
             self._entries[frag_id] = ent
@@ -253,12 +275,16 @@ class FragmentStore:
     def release(self, ids: list[str]) -> None:
         with self._lock:
             for fid in ids:
+                self._released[fid] = None
+                self._released.move_to_end(fid)
                 ent = self._entries.pop(fid, None)
                 if ent is not None and ent.spill_path:
                     try:
                         os.unlink(ent.spill_path)
                     except OSError:
                         pass
+            while len(self._released) > TOMBSTONE_CAP:
+                self._released.popitem(last=False)
 
     # --- reads ---
 

@@ -1,0 +1,228 @@
+"""Deterministic fault injection for the cluster tier.
+
+The failure-model layer (cluster/rpc.py policies, coordinator recovery,
+query deadlines) is only trustworthy if its paths actually run, and real
+clusters fail too rarely — and too irreproducibly — to exercise them. This
+module injects failures at named points wrapped around every server handler
+(``worker.do_action.<type>``, ``worker.do_get``, ``coordinator.do_action.
+<type>``, ...) and around the client-side RPC policy (``client.action.
+<name>``, ``client.do_get``), driven by a spec:
+
+    IGLOO_FAULTS="<point-glob>:<mode>:<prob>[:<count>][,<rule>...]"
+
+- ``point-glob``  fnmatch glob over injection-point names
+                  (``worker.do_action.execute_fragment``, ``worker.*``, ...)
+- ``mode``        ``error``  raise FlightUnavailableError (retryable class)
+                  ``delay``  sleep IGLOO_FAULTS_DELAY_S (default 0.05 s)
+                  ``hang``   sleep IGLOO_FAULTS_HANG_S (default 3600 s) — the
+                             "TCP accepts, never answers" worker
+                  ``drop-mid-stream``  for the streaming points
+                             (``worker.do_get``, ``coordinator.do_get``):
+                             serve one batch, then fail the stream
+- ``prob``        per-call injection probability in [0, 1]
+- ``count``       optional cap on total injections for the rule
+
+Runs REPLAY: each rule draws from its own ``random.Random`` seeded from
+(IGLOO_FAULTS_SEED, rule index, rule text), so the Nth call matching a rule
+gets the same decision in every run — chaos tests can assert exact fault
+schedules instead of flaking.
+
+Off by default and zero-overhead when unset: with no spec installed,
+``inject()`` is one module-global ``is None`` check. Servers re-read the
+environment at construction (``refresh()``), so in-process test clusters
+created after ``monkeypatch.setenv`` see the spec without a respawn.
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from igloo_tpu.utils import tracing
+
+FAULTS_ENV = "IGLOO_FAULTS"
+SEED_ENV = "IGLOO_FAULTS_SEED"
+DELAY_ENV = "IGLOO_FAULTS_DELAY_S"
+HANG_ENV = "IGLOO_FAULTS_HANG_S"
+
+MODES = ("error", "delay", "hang", "drop-mid-stream")
+
+
+class FaultSpecError(ValueError):
+    """Malformed IGLOO_FAULTS spec (raised at install time, never mid-RPC)."""
+
+
+@dataclass
+class FaultRule:
+    pattern: str
+    mode: str
+    prob: float
+    count: Optional[int] = None        # remaining injection budget
+    rng: object = None                 # per-rule random.Random
+    fired: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def decide(self) -> bool:
+        """One seeded draw; True = inject (and consume budget)."""
+        with self._lock:
+            if self.count is not None and self.fired >= self.count:
+                return False
+            if self.rng.random() >= self.prob:
+                return False
+            self.fired += 1
+            return True
+
+
+class FaultInjector:
+    def __init__(self, spec: str, seed: int = 0,
+                 delay_s: Optional[float] = None,
+                 hang_s: Optional[float] = None):
+        self.spec = spec
+        self.seed = seed
+        self.delay_s = delay_s if delay_s is not None else \
+            float(os.environ.get(DELAY_ENV, "0.05"))
+        self.hang_s = hang_s if hang_s is not None else \
+            float(os.environ.get(HANG_ENV, "3600"))
+        self.rules = self._parse(spec, seed)
+
+    @staticmethod
+    def _parse(spec: str, seed: int) -> list:
+        import random
+        rules = []
+        for i, part in enumerate(p.strip() for p in spec.split(",")):
+            if not part:
+                continue
+            bits = part.split(":")
+            if len(bits) not in (3, 4):
+                raise FaultSpecError(
+                    f"bad fault rule {part!r}: want "
+                    "<glob>:<mode>:<prob>[:<count>]")
+            pattern, mode, prob = bits[0], bits[1], bits[2]
+            if mode not in MODES:
+                raise FaultSpecError(
+                    f"bad fault mode {mode!r} in {part!r} "
+                    f"(one of {'|'.join(MODES)})")
+            try:
+                p_ = float(prob)
+            except ValueError:
+                raise FaultSpecError(f"bad probability {prob!r} in {part!r}")
+            if not 0.0 <= p_ <= 1.0:
+                raise FaultSpecError(f"probability {p_} not in [0,1]")
+            count = None
+            if len(bits) == 4:
+                try:
+                    count = int(bits[3])
+                except ValueError:
+                    raise FaultSpecError(f"bad count {bits[3]!r} in {part!r}")
+            # string seeds hash deterministically in random.Random — every
+            # process with the same spec+seed replays the same schedule
+            rng = random.Random(f"{seed}:{i}:{part}")
+            rules.append(FaultRule(pattern=pattern, mode=mode, prob=p_,
+                                   count=count, rng=rng))
+        return rules
+
+    def match(self, point: str, stream: bool = False) -> Optional[FaultRule]:
+        """First firing rule for `point`. Stream points only take
+        drop-mid-stream rules; call points take everything else."""
+        for r in self.rules:
+            if (r.mode == "drop-mid-stream") is not stream:
+                continue
+            if fnmatch.fnmatchcase(point, r.pattern) and r.decide():
+                return r
+        return None
+
+
+_INJECTOR: Optional[FaultInjector] = None
+_LOADED = False
+
+
+def refresh() -> Optional[FaultInjector]:
+    """(Re-)install the injector from the environment. Called by server
+    constructors and the CLI entries; tests that set IGLOO_FAULTS after
+    import call this (or construct a server, which does)."""
+    global _INJECTOR, _LOADED
+    spec = os.environ.get(FAULTS_ENV, "")
+    _INJECTOR = FaultInjector(spec, int(os.environ.get(SEED_ENV, "0"))) \
+        if spec else None
+    _LOADED = True
+    return _INJECTOR
+
+
+def install(spec: str, seed: int = 0, **kw) -> FaultInjector:
+    """Programmatic install (tests); `clear()` to remove."""
+    global _INJECTOR, _LOADED
+    _INJECTOR = FaultInjector(spec, seed, **kw)
+    _LOADED = True
+    return _INJECTOR
+
+
+def clear() -> None:
+    global _INJECTOR, _LOADED
+    _INJECTOR = None
+    _LOADED = True
+
+
+def active() -> bool:
+    return _INJECTOR is not None
+
+
+def inject(point: str) -> None:
+    """The per-call injection hook. No spec installed = one None check."""
+    inj = _INJECTOR
+    if inj is None:
+        if _LOADED:
+            return
+        try:
+            inj = refresh()
+        except FaultSpecError as ex:
+            # lazy load happens inside an RPC (a client-only process never
+            # runs a server constructor): a malformed spec must not surface
+            # as a failure of an unrelated query — disable with one loud
+            # line. Servers and CLIs still fail fast: their refresh() at
+            # construction raises at install time.
+            import sys
+            print(f"igloo faults: ignoring malformed {FAULTS_ENV}: {ex}",
+                  file=sys.stderr)
+            clear()
+            return
+        if inj is None:
+            return
+    rule = inj.match(point)
+    if rule is None:
+        return
+    tracing.counter("faults.injected")
+    if rule.mode == "delay":
+        time.sleep(inj.delay_s)
+        return
+    if rule.mode == "hang":
+        time.sleep(inj.hang_s)
+        return
+    import pyarrow.flight as flight
+    raise flight.FlightUnavailableError(
+        f"igloo fault injection: {rule.pattern}:{rule.mode} at {point}")
+
+
+def wrap_stream(point: str, batches: Iterator) -> Iterator:
+    """Apply a drop-mid-stream rule to a batch stream: decided ONCE when the
+    stream opens (seeded draw), the wrapped stream serves exactly one batch
+    and then dies the way a vanished peer does."""
+    inj = _INJECTOR
+    if inj is None:
+        return batches
+    rule = inj.match(point, stream=True)
+    if rule is None:
+        return batches
+
+    def dropped():
+        import pyarrow.flight as flight
+        tracing.counter("faults.injected")
+        for b in batches:
+            yield b
+            break
+        raise flight.FlightUnavailableError(
+            f"igloo fault injection: {rule.pattern}:drop-mid-stream "
+            f"at {point}")
+    return dropped()

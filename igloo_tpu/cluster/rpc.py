@@ -9,14 +9,35 @@ Flight calls then carry the token in an `x-igloo-token` header and servers
 reject calls without it. The token is a shared secret over plaintext gRPC:
 it gates access, it is not wire encryption; use a private network or mTLS
 termination in front for anything stronger.
+
+FAILURE MODEL: every helper here runs under an `RpcPolicy` — per-call
+deadline, bounded connect probe for streams, retry with exponential backoff +
+jitter — so a hung peer (TCP accepts, never answers) costs a bounded timeout
+instead of a wedged thread, and transient unavailability is retried instead
+of failing the query. Classification: `FlightUnavailableError` and timeouts
+are RETRYABLE (the peer may come back, or the coordinator will re-dispatch);
+`FlightUnauthenticatedError` and `FlightServerError` (a server-side
+application error) are FATAL — retrying a query that *failed* would mask
+bugs as flakes. Knobs: `IGLOO_RPC_*` env vars or `[rpc]` config
+(docs/distributed.md#failure-model). This module is the package's ONLY
+Flight connection site — the igloo-lint `rpc-policy` checker flags
+`flight.connect` anywhere else, so no code path can bypass the deadlines.
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
+import time
+import weakref
+from dataclasses import dataclass
 from typing import Optional
 
 import pyarrow.flight as flight
+
+from igloo_tpu.cluster import faults
+from igloo_tpu.errors import DeadlineExceededError
+from igloo_tpu.utils import tracing
 
 AUTH_TOKEN_ENV = "IGLOO_TPU_AUTH_TOKEN"
 _HEADER = "x-igloo-token"
@@ -26,13 +47,19 @@ def auth_token() -> Optional[str]:
     return os.environ.get(AUTH_TOKEN_ENV) or None
 
 
-def call_options() -> Optional[flight.FlightCallOptions]:
-    """FlightCallOptions carrying the shared token (None when unset)."""
+def call_options(timeout_s: Optional[float] = None
+                 ) -> Optional[flight.FlightCallOptions]:
+    """FlightCallOptions carrying the shared token and/or a gRPC deadline
+    (None when neither applies)."""
+    kw: dict = {}
     tok = auth_token()
-    if tok is None:
-        return None
-    return flight.FlightCallOptions(
-        headers=[(_HEADER.encode(), tok.encode())])
+    if tok is not None:
+        kw["headers"] = [(_HEADER.encode(), tok.encode())]
+    if timeout_s is not None:
+        # a deadline already in the past must still produce a DEADLINE_
+        # EXCEEDED status, not an invalid-argument error
+        kw["timeout"] = max(float(timeout_s), 0.001)
+    return flight.FlightCallOptions(**kw) if kw else None
 
 
 class TokenMiddlewareFactory(flight.ServerMiddlewareFactory):
@@ -129,58 +156,269 @@ def normalize(addr: str) -> str:
     return addr if "://" in addr else f"grpc+tcp://{addr}"
 
 
-def flight_action(addr: str, name: str, payload: Optional[dict] = None) -> dict:
-    """One-shot action RPC: connect, act, close. Returns the decoded first
-    result (or {})."""
-    body = flight_action_raw(addr, name, payload)
+# --- RPC policy: deadlines, retry, error classification ----------------------
+
+
+@dataclass(frozen=True)
+class RpcPolicy:
+    """Failure budget for one RPC: how long each attempt may take, how many
+    retryable failures to absorb, and how to back off between them.
+    Immutable — derive variants with `with_(...)`."""
+    connect_timeout_s: float = 5.0     # stream-open liveness probe bound
+    call_timeout_s: float = 120.0      # per-attempt gRPC deadline (actions)
+    stream_timeout_s: float = 600.0    # whole-stream gRPC deadline (do_get)
+    retries: int = 2                   # retryable-failure budget (attempts-1)
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    backoff_jitter: float = 0.25       # +-fraction of the backoff step
+
+    def with_(self, **kw) -> "RpcPolicy":
+        return dataclasses.replace(self, **kw)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Sleep before retry `attempt` (1-based): exponential, capped,
+        jittered so a wave of retries against one recovering server spreads
+        out instead of stampeding."""
+        import random
+        base = min(self.backoff_base_s * (2 ** (attempt - 1)),
+                   self.backoff_max_s)
+        if self.backoff_jitter <= 0:
+            return base
+        return base * (1.0 + random.uniform(-self.backoff_jitter,
+                                            self.backoff_jitter))
+
+
+_ENV_FIELDS = (("connect_timeout_s", "IGLOO_RPC_CONNECT_TIMEOUT_S"),
+               ("call_timeout_s", "IGLOO_RPC_CALL_TIMEOUT_S"),
+               ("stream_timeout_s", "IGLOO_RPC_STREAM_TIMEOUT_S"),
+               ("retries", "IGLOO_RPC_RETRIES"),
+               ("backoff_base_s", "IGLOO_RPC_BACKOFF_BASE_S"),
+               ("backoff_max_s", "IGLOO_RPC_BACKOFF_MAX_S"),
+               ("backoff_jitter", "IGLOO_RPC_BACKOFF_JITTER"))
+
+
+def policy_from_env(base: Optional[RpcPolicy] = None) -> RpcPolicy:
+    base = base or RpcPolicy()
+    kw = {}
+    for fld, env in _ENV_FIELDS:
+        v = os.environ.get(env)
+        if v:
+            kw[fld] = int(v) if fld == "retries" else float(v)
+    return base.with_(**kw) if kw else base
+
+
+_default_policy: Optional[RpcPolicy] = None
+
+
+def default_policy() -> RpcPolicy:
+    global _default_policy
+    if _default_policy is None:
+        _default_policy = policy_from_env()
+    return _default_policy
+
+
+def set_default_policy(policy: Optional[RpcPolicy]) -> None:
+    """Install a process-wide default (config loading); None re-reads env."""
+    global _default_policy
+    _default_policy = policy
+
+
+def retryable(ex: BaseException) -> bool:
+    """Retryable-vs-fatal error classification. Unavailable peers and
+    deadline-exceeded attempts may succeed elsewhere or later; auth failures
+    and server-side APPLICATION errors (the query itself failed) never will."""
+    if isinstance(ex, (flight.FlightUnauthenticatedError,
+                       flight.FlightServerError)):
+        return False
+    if isinstance(ex, (flight.FlightUnavailableError,
+                       flight.FlightTimedOutError)):
+        return True
+    if isinstance(ex, flight.FlightError):
+        return False  # internal / cancelled / unknown: do not mask
+    return isinstance(ex, (ConnectionError, OSError))
+
+
+def remaining_s(deadline: Optional[float]) -> Optional[float]:
+    """Seconds left until an absolute `time.time()` deadline (None = none)."""
+    return None if deadline is None else deadline - time.time()
+
+
+def check_deadline(deadline: Optional[float], what: str) -> None:
+    if deadline is not None and time.time() >= deadline:
+        tracing.counter("rpc.deadline_exceeded")
+        raise DeadlineExceededError(f"deadline exceeded before {what}")
+
+
+def _effective_timeout(base: float, deadline: Optional[float]) -> float:
+    """Per-attempt gRPC deadline: the policy bound, clamped to whatever is
+    left of the caller's absolute deadline."""
+    rem = remaining_s(deadline)
+    return base if rem is None else max(min(base, rem), 0.001)
+
+
+def connect(addr: str) -> flight.FlightClient:
+    """The package's ONE Flight connection site (gRPC connects lazily; the
+    per-call deadline in `call_options` bounds establishment + call). Every
+    other module must come through here or the `flight_*` helpers — enforced
+    by the igloo-lint `rpc-policy` checker."""
+    return flight.connect(normalize(addr))
+
+
+def _run_attempts(addr: str, what: str, fn, policy: Optional[RpcPolicy],
+                  deadline: Optional[float], close_on_success: bool = True):
+    """The ONE retry loop: connect per attempt, run `fn(client)`, classify-
+    then-retry with backoff, never past the caller's deadline. With
+    `close_on_success=False` the client survives a successful attempt (the
+    stream-open path — the connection must outlive the call); every failure
+    path still closes it."""
+    policy = policy or default_policy()
+    attempt = 0
+    while True:
+        check_deadline(deadline, what)
+        client = None
+        ok = False
+        try:
+            faults.inject(f"client.{what}")
+            client = connect(addr)
+            out = fn(client)
+            ok = True
+            return out
+        except Exception as ex:
+            if isinstance(ex, flight.FlightTimedOutError):
+                tracing.counter("rpc.timeouts")
+            if attempt >= policy.retries or not retryable(ex):
+                raise
+            attempt += 1
+            tracing.counter("rpc.retries")
+            delay = policy.backoff_s(attempt)
+            rem = remaining_s(deadline)
+            if rem is not None and rem <= delay:
+                # sleeping would burn the rest of the budget and the next
+                # loop's check_deadline would mask THIS error with a generic
+                # DeadlineExceededError — surface the real failure now
+                raise
+            time.sleep(delay)
+        finally:
+            if client is not None and not (ok and not close_on_success):
+                client.close()
+
+
+def _with_retry(addr: str, what: str, fn, policy: Optional[RpcPolicy],
+                deadline: Optional[float],
+                timeout_s: Optional[float] = None):
+    """Run `fn(client, options)` under the policy: per-attempt deadline
+    (recomputed each attempt as the caller's absolute deadline shrinks),
+    classify-then-retry with backoff."""
+    policy = policy or default_policy()
+
+    def attempt(client):
+        t = _effective_timeout(timeout_s or policy.call_timeout_s, deadline)
+        return fn(client, call_options(timeout_s=t))
+    return _run_attempts(addr, what, attempt, policy, deadline)
+
+
+def flight_action(addr: str, name: str, payload: Optional[dict] = None,
+                  policy: Optional[RpcPolicy] = None,
+                  deadline: Optional[float] = None,
+                  timeout_s: Optional[float] = None) -> dict:
+    """One-shot action RPC: connect, act, close — under the RPC policy
+    (per-call deadline, retry/backoff on retryable failures). Returns the
+    decoded first result (or {}). `deadline` is an absolute `time.time()`
+    bound the whole call (retries included) must respect."""
+    body = flight_action_raw(addr, name, payload, policy=policy,
+                             deadline=deadline, timeout_s=timeout_s)
     return json.loads(body) if body else {}
 
 
 def flight_action_raw(addr: str, name: str,
-                      payload: Optional[dict] = None) -> bytes:
+                      payload: Optional[dict] = None,
+                      policy: Optional[RpcPolicy] = None,
+                      deadline: Optional[float] = None,
+                      timeout_s: Optional[float] = None) -> bytes:
     """One-shot action RPC returning the raw first-result bytes — for
     actions whose payload is NOT JSON (the `metrics` Prometheus text)."""
-    client = flight.connect(normalize(addr))
-    try:
-        body = json.dumps(payload).encode() if payload is not None else b""
-        results = list(client.do_action(flight.Action(name, body),
-                                        call_options()))
-    finally:
-        client.close()
-    return results[0].body.to_pybytes() if results else b""
+    body = json.dumps(payload).encode() if payload is not None else b""
+
+    def call(client, options):
+        results = list(client.do_action(flight.Action(name, body), options))
+        return results[0].body.to_pybytes() if results else b""
+    return _with_retry(addr, f"action.{name}", call, policy, deadline,
+                       timeout_s)
 
 
-def flight_actions_raw(addr: str, actions):
+def flight_actions_raw(addr: str, actions,
+                       policy: Optional[RpcPolicy] = None):
     """Run several action RPCs over ONE connection, yielding each action's
     raw first-result bytes in order. `actions` iterates (name, payload)
     pairs. The connection closes when the generator is exhausted or closed —
     the worker's registration pre-warm pulls hundreds of compile-cache
-    entries and must not pay a TCP connect/teardown per entry."""
-    client = flight.connect(normalize(addr))
+    entries and must not pay a TCP connect/teardown per entry. Each call
+    carries the policy's per-call deadline but is NOT retried (callers — the
+    compile-cache push/pull loops — already have per-entry retry logic, and
+    replaying the already-consumed prefix of `actions` is impossible)."""
+    policy = policy or default_policy()
+    client = connect(addr)
     try:
         for name, payload in actions:
+            faults.inject(f"client.action.{name}")
             body = json.dumps(payload).encode() if payload is not None else b""
-            results = list(client.do_action(flight.Action(name, body),
-                                            call_options()))
+            results = list(client.do_action(
+                flight.Action(name, body),
+                call_options(timeout_s=policy.call_timeout_s)))
             yield results[0].body.to_pybytes() if results else b""
     finally:
         client.close()
 
 
-def flight_stream_batches(addr: str, ticket):
+def flight_stream_batches(addr: str, ticket,
+                          policy: Optional[RpcPolicy] = None,
+                          deadline: Optional[float] = None):
     """Streaming do_get: returns (schema, record-batch generator). The
     connection stays open until the generator is exhausted (or closed), so
     the consumer holds at most one in-flight batch instead of the whole
     result — the data-plane half of the fragment tier's streaming transfers.
-    `ticket` may be str or bytes (bucketed exchange tickets are JSON)."""
+    `ticket` may be str or bytes (bucketed exchange tickets are JSON).
+
+    Failure model: the OPEN (probe + do_get + schema) retries under the
+    policy; the stream itself runs under a gRPC deadline of
+    `stream_timeout_s` clamped to the caller's `deadline` and is never
+    retried mid-flight (the consumer re-fetches from scratch — batches
+    already yielded cannot be un-consumed). A bounded `ping` probe
+    (connect_timeout_s) catches a HUNG peer at open time; without it a
+    worker that accepts TCP but never answers would hold do_get for the
+    full stream timeout. The connection is also closed by a weakref
+    finalizer when a consumer ABANDONS the generator without closing it —
+    a never-started generator's close() does not run its finally block, and
+    before this fix each abandoned stream leaked one Flight connection."""
     raw = ticket if isinstance(ticket, bytes) else ticket.encode()
-    client = flight.connect(normalize(addr))
-    try:
-        reader = client.do_get(flight.Ticket(raw), call_options())
-        schema = reader.schema
-    except Exception:
-        client.close()
-        raise
+    policy = policy or default_policy()
+
+    def open_stream(c):
+        probe_t = _effective_timeout(policy.connect_timeout_s, deadline)
+        list(c.do_action(flight.Action("ping", b""),
+                         call_options(timeout_s=probe_t)))
+        t = _effective_timeout(policy.stream_timeout_s, deadline)
+        reader = c.do_get(flight.Ticket(raw), call_options(timeout_s=t))
+        # the schema read is where a hung/failed do_get actually surfaces —
+        # it must happen inside the retried attempt
+        return c, reader, reader.schema
+
+    client, reader, schema = _run_attempts(addr, "do_get", open_stream,
+                                           policy, deadline,
+                                           close_on_success=False)
+
+    done = [False]
+
+    def cleanup():
+        # idempotent: the generator's finally on the normal path, the
+        # weakref finalizer when the consumer drops an unstarted generator
+        if done[0]:
+            return
+        done[0] = True
+        try:
+            client.close()
+        except Exception:
+            pass
 
     def gen():
         try:
@@ -188,5 +426,7 @@ def flight_stream_batches(addr: str, ticket):
                 if chunk.data is not None:
                     yield chunk.data
         finally:
-            client.close()
-    return schema, gen()
+            cleanup()
+    g = gen()
+    weakref.finalize(g, cleanup)
+    return schema, g

@@ -33,7 +33,7 @@ import pyarrow as pa
 import pyarrow.flight as flight
 
 from igloo_tpu.catalog import Catalog, MemTable
-from igloo_tpu.cluster import exchange, serde
+from igloo_tpu.cluster import exchange, faults, serde
 from igloo_tpu.cluster.fragment import FRAG_PREFIX, _frag_refs
 from igloo_tpu.cluster import rpc
 from igloo_tpu.cluster.rpc import flight_action, flight_stream_batches
@@ -89,6 +89,8 @@ class WorkerServer(flight.FlightServerBase):
             kw.setdefault("auth_handler", ah)
         rpc.warn_if_open_bind(location.split("://")[-1].rsplit(":", 1)[0],
                               "worker")
+        # pick up IGLOO_FAULTS set after import (in-process test clusters)
+        faults.refresh()
         super().__init__(location, **kw)
         self.worker_id = worker_id or uuid.uuid4().hex[:12]
         self.advertise: str = location
@@ -132,7 +134,8 @@ class WorkerServer(flight.FlightServerBase):
 
     def _fetch_dep(self, frag_id: str, addr: str,
                    bucket: Optional[int] = None,
-                   nbuckets: Optional[int] = None) -> pa.Table:
+                   nbuckets: Optional[int] = None,
+                   deadline: Optional[float] = None) -> pa.Table:
         # own store first: a co-located dependency (or its bucket slice) is a
         # zero-copy local read, not a transfer
         if frag_id in self._store:
@@ -145,10 +148,14 @@ class WorkerServer(flight.FlightServerBase):
             return self._store.get_table(dep_key)
         # peer fetch: the worker that executed the dependency streams it
         # batch-wise; an unreachable peer is reported with a marker the
-        # coordinator recognizes (it requeues the dependency on a live worker)
+        # coordinator recognizes (it requeues the dependency on a live
+        # worker). `deadline` is the query's remaining budget (shipped by the
+        # coordinator as a relative timeout_s) — a HUNG peer becomes
+        # DEP_UNAVAILABLE at the deadline instead of wedging the fragment.
         try:
             ticket = exchange.make_ticket(frag_id, bucket, nbuckets)
-            schema, batch_iter = flight_stream_batches(addr, ticket)
+            schema, batch_iter = flight_stream_batches(addr, ticket,
+                                                       deadline=deadline)
             batches = []
             for batch in batch_iter:
                 batches.append(batch)
@@ -166,6 +173,11 @@ class WorkerServer(flight.FlightServerBase):
     def _execute_fragment(self, req: dict) -> dict:
         frag_id = req["id"]
         addr_of = {d["id"]: d["addr"] for d in req.get("deps", [])}
+        # the coordinator ships the query's remaining budget as a RELATIVE
+        # timeout (clocks differ across machines); anchor it here
+        deadline = None
+        if req.get("timeout_s") is not None:
+            deadline = time.time() + float(req["timeout_s"])
         overlay: dict = {}
         input_rows = 0
         # per-fragment counter delta: thread-isolated, so concurrent
@@ -178,7 +190,8 @@ class WorkerServer(flight.FlightServerBase):
                 if name in overlay:
                     continue
                 t = self._fetch_dep(dep_id, addr_of.get(dep_id, ""),
-                                    ref.get("bucket"), ref.get("buckets"))
+                                    ref.get("bucket"), ref.get("buckets"),
+                                    deadline=deadline)
                 input_rows += t.num_rows
                 overlay[name] = MemTable(t)
             dep_s = time.perf_counter() - t_dep0
@@ -212,6 +225,7 @@ class WorkerServer(flight.FlightServerBase):
     # --- Flight surface ---
 
     def do_action(self, context, action):
+        faults.inject(f"worker.do_action.{action.type}")
         body = action.body.to_pybytes() if action.body is not None else b""
         req = json.loads(body) if body else {}
         if action.type == "execute_fragment":
@@ -250,6 +264,7 @@ class WorkerServer(flight.FlightServerBase):
                 ("metrics", "process metrics, Prometheus text format")]
 
     def do_get(self, context, ticket):
+        faults.inject("worker.do_get")
         frag_id, bucket, nbuckets = exchange.parse_ticket(ticket.ticket)
         try:
             schema, batches = self._store.stream(frag_id, bucket, nbuckets)
@@ -265,23 +280,40 @@ class WorkerServer(flight.FlightServerBase):
                 yield b
         # GeneratorStream: one in-flight batch, never the whole table — a
         # spilled fragment streams straight off its IPC spill file
-        return flight.GeneratorStream(schema, counted())
+        return flight.GeneratorStream(
+            schema, faults.wrap_stream("worker.do_get", counted()))
 
 
 class Worker:
     """Worker lifecycle: serve + register + heartbeat (main.rs:14-52 parity)."""
 
+    #: registration keeps retrying (with backoff) for this long before the
+    #: worker gives up — a worker started BEFORE its coordinator must wait
+    #: for it, not die instantly (the reference leaves this as a TODO
+    #: comment, main.rs:37-38)
+    REGISTER_TIMEOUT_ENV = "IGLOO_WORKER_REGISTER_TIMEOUT_S"
+
     def __init__(self, coordinator: str, host: str = "127.0.0.1",
                  port: int = 0, heartbeat_interval_s: float = 5.0,
                  use_jit: bool = True,
-                 store_budget_bytes: Optional[int] = None):
+                 store_budget_bytes: Optional[int] = None,
+                 register_timeout_s: Optional[float] = None):
         self.server = WorkerServer(f"grpc+tcp://{host}:{port}", use_jit=use_jit,
                                    store_budget_bytes=store_budget_bytes)
         self.server.advertise = f"grpc+tcp://{host}:{self.server.port}"
         self.coordinator = _normalize(coordinator)
         self.heartbeat_interval_s = heartbeat_interval_s
+        if register_timeout_s is None:
+            import os
+            register_timeout_s = float(
+                os.environ.get(self.REGISTER_TIMEOUT_ENV, "30"))
+        self.register_timeout_s = register_timeout_s
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        # heartbeat-failure edge detector: log the FIRST consecutive failure
+        # (and the recovery), never the repeats — a coordinator outage must
+        # not turn every worker's log into a 5s-period spam stream
+        self._hb_down = False
         # compile-cache entry names this worker knows the coordinator has
         # (seeded at registration, grown by pushes); touched only by the
         # registering thread and then the heartbeat thread, never both
@@ -301,12 +333,44 @@ class Worker:
                                            daemon=True)
         self._hb_thread.start()
 
-    def _coordinator_action(self, name: str, payload: dict) -> dict:
-        return flight_action(self.coordinator, name, payload)
+    def _coordinator_action(self, name: str, payload: dict,
+                            deadline: Optional[float] = None) -> dict:
+        return flight_action(self.coordinator, name, payload,
+                             deadline=deadline)
 
     def _register(self) -> None:
-        resp = self._coordinator_action("register_worker", {
-            "id": self.server.worker_id, "addr": self.server.advertise})
+        """Register with bounded retry + backoff: each attempt already
+        carries the RPC policy's own (small) retry budget, so this loop only
+        spans the LONG wait — a coordinator that isn't up yet or is
+        restarting. Fatal errors (auth, server-side rejection) fail fast."""
+        policy = rpc.default_policy()
+        deadline = time.time() + self.register_timeout_s
+        attempt = 0
+        while True:
+            try:
+                # the give-up deadline bounds each attempt's gRPC timeout
+                # too: against a HUNG coordinator (accepts, never answers)
+                # one un-deadlined attempt would otherwise block
+                # call_timeout_s x (1 + retries) — minutes past the
+                # documented register_timeout_s
+                resp = self._coordinator_action(
+                    "register_worker",
+                    {"id": self.server.worker_id,
+                     "addr": self.server.advertise},
+                    deadline=deadline)
+                break
+            except Exception as ex:
+                if not rpc.retryable(ex) or self._stop.is_set() or \
+                        time.time() >= deadline:
+                    raise
+                attempt += 1
+                tracing.counter("worker.register_retries")
+                # cap the step so a short register_timeout still gets many
+                # attempts; never sleep past the give-up deadline
+                delay = min(policy.backoff_s(attempt) * 10, 2.0,
+                            max(deadline - time.time(), 0.05))
+                if self._stop.wait(delay):
+                    raise
         try:
             self._adopt_compile_cache(resp.get("compile_cache") or {})
         except Exception:
@@ -439,6 +503,7 @@ class Worker:
         # a failed heartbeat retries next tick; a coordinator that no longer
         # knows us (restarted, or it evicted us during a network blip)
         # answers ok=false and we re-register
+        import sys
         while not self._stop.wait(self.heartbeat_interval_s):
             try:
                 resp = self._coordinator_action("heartbeat", {
@@ -449,8 +514,20 @@ class Worker:
                     self._register()
                     tracing.counter("worker.reregistrations")
                 self._push_compile_cache()
-            except Exception:
+                if self._hb_down:
+                    self._hb_down = False
+                    print(f"igloo-worker {self.server.worker_id}: heartbeat "
+                          f"to {self.coordinator} recovered", file=sys.stderr)
+            except Exception as ex:
                 tracing.counter("worker.heartbeat_failures")
+                if not self._hb_down:
+                    # log the EDGE, count the repeats: one line per outage
+                    self._hb_down = True
+                    print(f"igloo-worker {self.server.worker_id}: heartbeat "
+                          f"to {self.coordinator} failing "
+                          f"({type(ex).__name__}: {ex}); will keep retrying "
+                          f"every {self.heartbeat_interval_s}s (further "
+                          f"failures counted, not logged)", file=sys.stderr)
 
     def serve_forever(self) -> None:
         self.server.serve()  # blocks
@@ -474,8 +551,13 @@ def main(argv=None) -> int:
 
     hb = 5.0
     if args.config:
-        from igloo_tpu.config import Config
-        hb = Config.load(args.config).cluster.heartbeat_interval_s
+        from igloo_tpu.config import Config, rpc_policy
+        cfg = Config.load(args.config)
+        hb = cfg.cluster.heartbeat_interval_s
+        # [rpc] config is the base; IGLOO_RPC_* env still wins per-field
+        # (the worker's registration, heartbeats, and peer dep-fetches all
+        # run under this policy)
+        rpc.set_default_policy(rpc.policy_from_env(rpc_policy(cfg)))
     w = Worker(args.coordinator, host=args.host, port=args.port,
                heartbeat_interval_s=hb)
     w.start()

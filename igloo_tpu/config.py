@@ -44,6 +44,28 @@ class ClusterConfig:
 
 
 @dataclass
+class RpcConfig:
+    """Cluster failure budget ([rpc] TOML section; every field is also
+    overridable per-process via the matching IGLOO_RPC_* env var, and
+    `query_deadline_s` via IGLOO_QUERY_DEADLINE_S — env wins). See
+    docs/distributed.md#failure-model for the semantics.
+
+    Every field defaults to None = "not set in the TOML": `rpc_policy()`
+    passes only the set fields through, so the numeric defaults live in ONE
+    place — cluster/rpc.py's RpcPolicy — instead of a hand-maintained copy
+    here that would silently shadow a tuned default."""
+    connect_timeout_s: Optional[float] = None
+    call_timeout_s: Optional[float] = None
+    stream_timeout_s: Optional[float] = None
+    retries: Optional[int] = None
+    backoff_base_s: Optional[float] = None
+    backoff_max_s: Optional[float] = None
+    backoff_jitter: Optional[float] = None
+    # default per-query deadline for distributed execution; None = unbounded
+    query_deadline_s: Optional[float] = None
+
+
+@dataclass
 class DistributedConfig:
     """Multi-host JAX runtime (SURVEY #20 "jax distributed init").
 
@@ -70,6 +92,7 @@ class Config:
     mesh_axes: list[str] = field(default_factory=lambda: ["data"])
     cache_budget_bytes: int = 1 << 30
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    rpc: RpcConfig = field(default_factory=RpcConfig)
     distributed: DistributedConfig = field(default_factory=DistributedConfig)
     use_jit: bool = True
 
@@ -106,6 +129,12 @@ class Config:
                   "worker_timeout_s"):
             if k in cl:
                 setattr(cfg.cluster, k, cl[k])
+        rp = raw.get("rpc", {})
+        for k in ("connect_timeout_s", "call_timeout_s", "stream_timeout_s",
+                  "retries", "backoff_base_s", "backoff_max_s",
+                  "backoff_jitter", "query_deadline_s"):
+            if k in rp:
+                setattr(cfg.rpc, k, rp[k])
         ds = raw.get("distributed", {})
         for k in ("enabled", "coordinator_address", "num_processes",
                   "process_id", "local_device_ids"):
@@ -136,6 +165,20 @@ def init_distributed(cfg: "Config") -> bool:
         kw["local_device_ids"] = d.local_device_ids
     jax.distributed.initialize(**kw)
     return True
+
+
+def rpc_policy(cfg: "Config"):
+    """[rpc] section -> cluster RpcPolicy (imported lazily: config loading
+    must not pull pyarrow.flight into processes that never talk Flight).
+    Only fields actually set in the TOML are passed — unset ones keep the
+    RpcPolicy defaults."""
+    from igloo_tpu.cluster.rpc import RpcPolicy
+    kw = {f: getattr(cfg.rpc, f)
+          for f in ("connect_timeout_s", "call_timeout_s", "stream_timeout_s",
+                    "retries", "backoff_base_s", "backoff_max_s",
+                    "backoff_jitter")
+          if getattr(cfg.rpc, f) is not None}
+    return RpcPolicy(**kw)
 
 
 def make_provider(t: TableConfig):

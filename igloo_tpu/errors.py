@@ -34,5 +34,13 @@ class TransportError(IglooError):
     """RPC / serialization failures in the distributed tier."""
 
 
+class DeadlineExceededError(IglooError):
+    """A query (or RPC) exhausted its deadline budget before completing."""
+
+
+class QueryCancelledError(IglooError):
+    """Query cancelled via its cancellation token / `cancel_query`."""
+
+
 class NotSupportedError(IglooError):
     """Feature declared by SQL but outside the engine's dialect."""

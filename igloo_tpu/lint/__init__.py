@@ -27,7 +27,11 @@ visitors (docs/static_analysis.md has the rule catalog):
                       a caller-locked method);
 - ``metric-names``    tracing counter/histogram names must match the catalog
                       in docs/observability.md (migrated from
-                      scripts/check_metrics_names.py).
+                      scripts/check_metrics_names.py);
+- ``rpc-policy``      no ``flight.connect`` / ``FlightClient`` outside
+                      ``cluster/rpc.py`` — every Flight connection must run
+                      under the RPC policy (deadlines, retry/backoff), or a
+                      hung peer wedges the calling thread forever.
 
 Suppress a finding with a trailing ``# lint: allow(<rule>)`` comment on the
 offending line (or a standalone allow-comment on the line directly above);
@@ -139,9 +143,11 @@ def default_checkers() -> list:
     from igloo_tpu.lint.jit_key import JitKeyChecker
     from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
     from igloo_tpu.lint.metric_names import MetricNamesChecker
+    from igloo_tpu.lint.rpc_policy import RpcPolicyChecker
     from igloo_tpu.lint.sync_hazard import SyncHazardChecker
     return [SyncHazardChecker(), CacheKeyChecker(), JitKeyChecker(),
-            LockDisciplineChecker(), MetricNamesChecker()]
+            LockDisciplineChecker(), MetricNamesChecker(),
+            RpcPolicyChecker()]
 
 
 def run_lint(paths: Optional[list] = None, checkers: Optional[list] = None,

@@ -1,0 +1,87 @@
+"""rpc-policy: every Flight connection must go through cluster/rpc.py.
+
+The failure model (per-call deadlines, retry/backoff, retryable-vs-fatal
+classification — docs/distributed.md#failure-model) lives in the
+`cluster/rpc.py` helpers. A raw ``flight.connect(...)`` or
+``FlightClient(...)`` anywhere else creates a connection with NO deadline:
+one hung peer then wedges that code path forever, exactly the bug class the
+RPC policy exists to kill. This checker flags both call forms (through any
+import alias of ``pyarrow.flight``) in every package module except
+``cluster/rpc.py`` itself — so no future code path can bypass the policy.
+
+Scope is the package only: tests and examples legitimately use stock
+clients (interop is the point of speaking Arrow Flight).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from igloo_tpu.lint import Checker, Finding, LintModule, dotted
+
+RULE = "rpc-policy"
+
+#: the ONE module allowed to open Flight connections
+CONNECT_SITE = "igloo_tpu/cluster/rpc.py"
+
+_MSG = ("direct Flight connection bypasses the RPC policy "
+        "(deadlines/retry/backoff) — use the igloo_tpu.cluster.rpc helpers "
+        "(connect / flight_action* / flight_stream_batches)")
+
+
+def _flight_aliases(tree: ast.Module) -> tuple[set, set]:
+    """(module aliases of pyarrow.flight, direct aliases of connect/
+    FlightClient). Covers `import pyarrow.flight as X`, `import pyarrow
+    as P` (usage `P.flight.connect`), `from pyarrow import flight as X`,
+    `from pyarrow.flight import connect as Y, FlightClient as Z`."""
+    mod_aliases: set = set()
+    fn_aliases: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "pyarrow.flight":
+                    # `import pyarrow.flight` binds `pyarrow`; usage is the
+                    # dotted pyarrow.flight.connect form, handled below
+                    mod_aliases.add(a.asname or "pyarrow.flight")
+                elif a.name == "pyarrow":
+                    # `import pyarrow as pa` reaches the flight submodule as
+                    # `pa.flight` once ANY module in the process imported it
+                    # (every cluster module does) — `pa.flight.connect(...)`
+                    # is a live bypass, not a hypothetical
+                    mod_aliases.add((a.asname or "pyarrow") + ".flight")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "pyarrow":
+                for a in node.names:
+                    if a.name == "flight":
+                        mod_aliases.add(a.asname or "flight")
+            elif node.module == "pyarrow.flight":
+                for a in node.names:
+                    if a.name in ("connect", "FlightClient"):
+                        fn_aliases.add(a.asname or a.name)
+    return mod_aliases, fn_aliases
+
+
+class RpcPolicyChecker(Checker):
+    name = RULE
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        if mod.relpath == CONNECT_SITE or \
+                not mod.relpath.startswith("igloo_tpu/"):
+            return
+        mod_aliases, fn_aliases = _flight_aliases(mod.tree)
+        if not mod_aliases and not fn_aliases:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            hit = name in fn_aliases
+            if not hit and "." in name:
+                base, leaf = name.rsplit(".", 1)
+                hit = leaf in ("connect", "FlightClient") and \
+                    base in mod_aliases
+            if hit:
+                yield Finding(RULE, mod.relpath, node.lineno,
+                              f"`{name}(...)`: {_MSG}")

@@ -113,6 +113,7 @@ class QueryLogTable(_SystemTable):
         pa.field("grace_partitions", pa.int64(), False),
         pa.field("jit_misses", pa.int64(), False),
         pa.field("cache_hits", pa.int64(), False),
+        pa.field("status", pa.string(), False),
     ])
 
     def _build(self) -> pa.Table:

@@ -85,6 +85,9 @@ class QueryStats:
     root: Optional[OpStats] = None
     detail: bool = False
     qid: int = 0
+    # terminal outcome: "ok" | "cancelled" | "deadline_exceeded" | "error"
+    # (non-ok values come from the distributed tier's deadline/cancel paths)
+    status: str = "ok"
 
     # --- programmatic access ------------------------------------------------
 
@@ -120,6 +123,7 @@ class QueryStats:
             "jit_misses": int(self.counters.get("jit.miss", 0)),
             "cache_hits": int(self.counters.get("cache.hit", 0) +
                               self.counters.get("result_cache.hit", 0)),
+            "status": self.status,
         }
 
 
@@ -376,6 +380,25 @@ def _append_log(qs: QueryStats) -> None:
                 f.write(json.dumps(qs.to_record(), default=str) + "\n")
         except OSError:  # export is best-effort; never fail the query
             tracing.counter("stats.query_log_write_failed")
+
+
+def log_query(sql: str, elapsed_s: float, tier: str = "distributed",
+              rows: Optional[int] = None, status: str = "ok",
+              started_at: Optional[float] = None) -> QueryStats:
+    """Append a query-log record for a query NOT executed through
+    `collect()` — the coordinator's distributed path logs every query here,
+    including cancelled / deadline-exceeded ones that never finished (their
+    `status` column is how an operator audits what the cluster dropped)."""
+    global _query_seq
+    with _log_lock:
+        _query_seq += 1
+        qid = _query_seq
+    qs = QueryStats(sql=sql, elapsed_s=elapsed_s, tier=tier, rows=rows,
+                    status=status, qid=qid,
+                    started_at=started_at if started_at is not None
+                    else time.time() - elapsed_s)
+    _append_log(qs)
+    return qs
 
 
 def query_log() -> list:

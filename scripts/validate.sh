@@ -18,6 +18,9 @@ fi
 echo "== 2-worker shuffle-join smoke (fragment-tier exchange) =="
 python scripts/shuffle_smoke.py
 
+echo "== chaos smoke (injected faults + worker kill + hung worker) =="
+python scripts/chaos_smoke.py
+
 echo "== persistent compile-cache smoke (two-process cold/warm) =="
 python scripts/compile_cache_smoke.py
 

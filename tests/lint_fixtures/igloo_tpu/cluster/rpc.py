@@ -1,0 +1,8 @@
+"""rpc-policy clean fixture: this file IS igloo_tpu/cluster/rpc.py (the
+fixture tree mirrors the package layout), so its raw connects are the one
+allowed connection site. Never imported."""
+import pyarrow.flight as flight
+
+
+def connect(addr):
+    return flight.connect(addr)  # allowed: the policy layer itself

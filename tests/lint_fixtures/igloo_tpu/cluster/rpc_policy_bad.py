@@ -1,0 +1,37 @@
+"""rpc-policy bad fixture: raw Flight connections outside cluster/rpc.py —
+every alias form the checker must see through. Never imported."""
+import pyarrow as pa
+import pyarrow.flight as flight
+from pyarrow import flight as fl
+from pyarrow.flight import FlightClient, connect
+
+
+def through_pyarrow_alias(addr):
+    # works at runtime because some other module already imported
+    # pyarrow.flight — the sneakiest bypass form
+    a = pa.flight.connect(addr)  # BAD
+    b = pa.flight.FlightClient(addr)  # BAD
+    return a, b
+
+
+def direct_module_alias(addr):
+    return flight.connect(addr)  # BAD
+
+
+def from_pyarrow_alias(addr):
+    return fl.connect(addr)  # BAD
+
+
+def client_class_via_module(addr):
+    return flight.FlightClient(addr)  # BAD
+
+
+def imported_names(addr):
+    a = connect(addr)  # BAD
+    b = FlightClient(addr)  # BAD
+    return a, b
+
+
+def suppressed(addr):
+    # this one is deliberate and documented, e.g. a raw interop probe
+    return flight.connect(addr)  # lint: allow(rpc-policy)

@@ -1,0 +1,29 @@
+"""rpc-policy clean fixture: Flight USAGE without opening connections —
+helpers from cluster/rpc.py, flight types/errors — must not flag. Never
+imported."""
+import pyarrow.flight as flight
+
+from igloo_tpu.cluster import rpc
+
+
+def through_the_policy(addr):
+    client = rpc.connect(addr)
+    try:
+        return rpc.flight_action(addr, "ping")
+    finally:
+        client.close()
+
+
+def flight_types_are_fine(ex):
+    # referencing flight errors/types is not a connection
+    if isinstance(ex, flight.FlightUnavailableError):
+        return flight.Ticket(b"x")
+    return None
+
+
+def pyarrow_alias_is_fine(batches, schema):
+    # `import pyarrow as pa` alone must not flag non-connect usage
+    import pyarrow as pa
+    if isinstance(schema, pa.flight.FlightDescriptor):
+        return None
+    return pa.Table.from_batches(batches, schema=schema)

@@ -1,0 +1,130 @@
+"""Fault-injection layer unit tests: spec grammar, seeded replay
+determinism, glob scoping, budget caps, and the off-by-default fast path.
+Pure in-process — no Flight servers, runs in well under a second."""
+import time
+
+import pytest
+
+from igloo_tpu.cluster import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# --- spec grammar ------------------------------------------------------------
+
+
+def test_spec_parses_rules():
+    inj = faults.FaultInjector(
+        "worker.do_action.execute_fragment:error:0.5:3, worker.do_get:"
+        "drop-mid-stream:1.0, client.*:delay:0.25")
+    assert [r.mode for r in inj.rules] == ["error", "drop-mid-stream",
+                                          "delay"]
+    assert inj.rules[0].count == 3 and inj.rules[1].count is None
+    assert inj.rules[2].pattern == "client.*"
+
+
+@pytest.mark.parametrize("bad", [
+    "worker.do_get",                       # no mode/prob
+    "worker.do_get:explode:0.5",           # unknown mode
+    "worker.do_get:error:nope",            # non-numeric prob
+    "worker.do_get:error:1.5",             # prob out of range
+    "worker.do_get:error:0.5:many",        # non-integer count
+])
+def test_bad_specs_fail_at_install(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultInjector(bad)
+
+
+# --- determinism -------------------------------------------------------------
+
+
+def _schedule(seed, points, spec="worker.*:error:0.3"):
+    inj = faults.FaultInjector(spec, seed=seed)
+    return [inj.match(p) is not None for p in points]
+
+
+def test_replay_is_deterministic():
+    points = ["worker.do_action.execute_fragment"] * 200
+    s1 = _schedule(7, points)
+    s2 = _schedule(7, points)
+    assert s1 == s2
+    assert any(s1) and not all(s1)  # p=0.3 over 200 draws: some, not all
+    # a different seed produces a different schedule
+    assert s1 != _schedule(8, points)
+
+
+def test_rule_isolation_keeps_replay_stable():
+    """Adding a rule for OTHER points must not perturb an existing rule's
+    schedule — each rule owns its RNG stream."""
+    points = ["worker.do_action.execute_fragment"] * 100
+    base = _schedule(3, points)
+    with_extra = _schedule(
+        3, points, spec="worker.*:error:0.3,coordinator.*:delay:0.9")
+    assert base == with_extra
+
+
+# --- scoping + budget --------------------------------------------------------
+
+
+def test_glob_scopes_points():
+    inj = faults.FaultInjector("worker.do_action.*:error:1.0")
+    assert inj.match("worker.do_action.execute_fragment") is not None
+    assert inj.match("worker.do_get") is None
+    assert inj.match("coordinator.do_action.heartbeat") is None
+
+
+def test_count_caps_injections():
+    inj = faults.FaultInjector("worker.*:error:1.0:2")
+    hits = sum(inj.match("worker.do_get") is not None for _ in range(10))
+    assert hits == 2
+
+
+def test_stream_rules_only_apply_to_streams():
+    inj = faults.FaultInjector("worker.do_get:drop-mid-stream:1.0")
+    assert inj.match("worker.do_get") is None           # call point
+    assert inj.match("worker.do_get", stream=True) is not None
+
+
+# --- the injection hooks -----------------------------------------------------
+
+
+def test_inject_error_raises_retryable_class():
+    import pyarrow.flight as flight
+    faults.install("worker.do_action.ping:error:1.0:1")
+    with pytest.raises(flight.FlightUnavailableError, match="fault injection"):
+        faults.inject("worker.do_action.ping")
+    faults.inject("worker.do_action.ping")  # budget spent: clean
+
+
+def test_inject_delay_sleeps():
+    faults.install("slowpoint:delay:1.0:1", delay_s=0.12)
+    t0 = time.perf_counter()
+    faults.inject("slowpoint")
+    assert time.perf_counter() - t0 >= 0.1
+
+
+def test_wrap_stream_drops_after_first_batch():
+    import pyarrow.flight as flight
+    faults.install("worker.do_get:drop-mid-stream:1.0:1")
+    wrapped = faults.wrap_stream("worker.do_get", iter([1, 2, 3]))
+    got = []
+    with pytest.raises(flight.FlightUnavailableError, match="drop-mid-stream"):
+        for b in wrapped:
+            got.append(b)
+    assert got == [1]
+    # budget spent: the next stream passes through untouched
+    assert list(faults.wrap_stream("worker.do_get", iter([1, 2]))) == [1, 2]
+
+
+def test_off_by_default_and_refresh(monkeypatch):
+    assert not faults.active()
+    faults.inject("worker.do_get")  # no-op, must not raise
+    monkeypatch.setenv(faults.FAULTS_ENV, "worker.*:error:1.0")
+    assert faults.refresh() is not None and faults.active()
+    monkeypatch.delenv(faults.FAULTS_ENV)
+    assert faults.refresh() is None and not faults.active()

@@ -9,6 +9,7 @@ from igloo_tpu.lint.cache_key import CacheKeyChecker
 from igloo_tpu.lint.jit_key import JitKeyChecker
 from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
 from igloo_tpu.lint.metric_names import MetricNamesChecker
+from igloo_tpu.lint.rpc_policy import RpcPolicyChecker
 from igloo_tpu.lint.sync_hazard import SyncHazardChecker
 
 FIXTURES = Path(__file__).parent / "lint_fixtures"
@@ -92,6 +93,27 @@ def test_jit_key_flags_bad_fixture():
 
 def test_jit_key_passes_clean_fixture():
     assert _lint([PKG / "jit_key_clean.py"], [JitKeyChecker()]) == []
+
+
+# --- rpc-policy -------------------------------------------------------------
+
+def test_rpc_policy_flags_bad_fixture():
+    f = _lint([PKG / "cluster" / "rpc_policy_bad.py"], [RpcPolicyChecker()])
+    lines = {x.line for x in f}
+    assert all(x.rule == "rpc-policy" for x in f)
+    src = (PKG / "cluster" / "rpc_policy_bad.py").read_text().splitlines()
+    bad_lines = {i for i, ln in enumerate(src, 1) if "# BAD" in ln}
+    assert lines == bad_lines, (sorted(lines), sorted(bad_lines))
+
+
+def test_rpc_policy_passes_clean_fixture():
+    assert _lint([PKG / "rpc_policy_clean.py"], [RpcPolicyChecker()]) == []
+
+
+def test_rpc_policy_exempts_the_connect_site():
+    # the fixture tree's igloo_tpu/cluster/rpc.py mirrors the real one: raw
+    # connects INSIDE the policy module are the whole point
+    assert _lint([PKG / "cluster" / "rpc.py"], [RpcPolicyChecker()]) == []
 
 
 # --- metric-names -----------------------------------------------------------
