@@ -53,7 +53,7 @@ _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 # not just detected
 _DELTA_PREFIXES = ("jit.", "pack.", "grace.", "chunked.", "xfer.",
                    "cache.", "result_cache.", "engine.", "fused.", "join.",
-                   "exchange.", "compile_cache.")
+                   "exchange.", "compile_cache.", "adaptive.")
 
 
 def _peak_hbm_bytes() -> int:
@@ -128,6 +128,22 @@ def run_query(engine, sql: str, trials: int) -> dict:
     # distributed mode
     rec["shuffle_buckets"] = query_delta.get("exchange.partitions")
     rec["exchange_bytes"] = query_delta.get("exchange.fetch_bytes")
+    # adaptive-execution decisions for this query (docs/adaptive.md): did
+    # the optimizer reorder a join spine, was the order driven by observed
+    # stats or estimates, and did the fragment tier broadcast/salt — the
+    # record that makes an A/B against IGLOO_ADAPTIVE=0 attributable
+    from igloo_tpu.exec.hints import adaptive_enabled
+    reorder = query_delta.get("adaptive.reorder") > 0
+    rec["adaptive"] = {
+        "enabled": adaptive_enabled(),
+        "reorder": reorder,
+        "adaptive_source": (
+            "observed" if query_delta.get("adaptive.reorder_observed")
+            else "estimated") if reorder else None,
+        "broadcast": query_delta.get("adaptive.broadcast"),
+        "salted": query_delta.get("adaptive.salted"),
+        "observed": query_delta.get("adaptive.observed"),
+    }
     joins = query_delta.get("grace.join")
     rec["grace"] = query_delta.get("engine.grace_route") > 0
     if rec["grace"]:

@@ -201,14 +201,17 @@ class DistributedExecutor:
 
     def execute(self, fragments: list[QueryFragment],
                 deadline_s: Optional[float] = None,
-                qid: Optional[str] = None, sql: str = "") -> pa.Table:
+                qid: Optional[str] = None, sql: str = "",
+                adaptive_info: Optional[list] = None) -> pa.Table:
         schema, gen = self.execute_stream(fragments, deadline_s=deadline_s,
-                                          qid=qid, sql=sql)
+                                          qid=qid, sql=sql,
+                                          adaptive_info=adaptive_info)
         return pa.Table.from_batches(list(gen), schema=schema)
 
     def execute_stream(self, fragments: list[QueryFragment],
                        deadline_s: Optional[float] = None,
-                       qid: Optional[str] = None, sql: str = ""
+                       qid: Optional[str] = None, sql: str = "",
+                       adaptive_info: Optional[list] = None
                        ) -> tuple[pa.Schema, object]:
         """Run the fragment waves, then return (schema, batch generator)
         streaming the root result from its worker — the coordinator never
@@ -251,6 +254,10 @@ class DistributedExecutor:
         shuffle_buckets = {f.bucket for f in fragments
                           if f.bucket is not None}
         metrics["shuffle_buckets"] = len(shuffle_buckets)
+        # the planner's per-join decision records (strategy / salt /
+        # adaptive_source), so sweep JSON and last_metrics show WHY this
+        # plan shape was chosen (docs/adaptive.md)
+        metrics["adaptive"] = list(adaptive_info or ())
         try:
             with cf.ThreadPoolExecutor(self.max_parallel) as pool:
                 while pending:
@@ -422,12 +429,62 @@ class DistributedExecutor:
             exchange_bytes=sum(i.get("exchange_bytes") or 0
                                for i in metrics["fragments"]),
             execution_time_s=round(time.time() - t_start, 6))
+        if status == "ok" and completed:
+            # feed the telemetry->planner loop: per-side observed rows /
+            # result bytes / skew sketch, under the fingerprint digests the
+            # planner tagged the fragments with (docs/adaptive.md)
+            self._record_adaptive(metrics["fragments"])
         pub = {k: v for k, v in metrics.items() if not k.startswith("_")}
         self.last_metrics = pub  # atomic publish
         self._accumulate(pub)
         stats.log_query(sql, elapsed_s=pub["execution_time_s"],
                         tier="distributed", rows=pub.get("total_rows"),
                         status=status, started_at=t_start)
+
+    def _record_adaptive(self, frag_infos: list) -> None:
+        """Fold a finished query's per-fragment reports into the process-wide
+        AdaptiveStats store, grouped by the planner's side digests: total
+        rows and result bytes per join side, plus the skew sketch (max
+        UNSALTED bucket share + hot bucket) from the exchange fragments'
+        per-bucket row counts. Best-effort by the stats safety contract."""
+        from igloo_tpu.exec import hints
+        if not hints.adaptive_enabled():
+            return
+        try:
+            by_key: dict = {}
+            for info in frag_infos:
+                sk = info.get("stats_key")
+                if not sk:
+                    continue
+                g = by_key.setdefault(sk, {"rows": 0, "bytes": 0,
+                                           "bucket_rows": None,
+                                           "buckets": None})
+                g["rows"] += int(info.get("rows") or 0)
+                g["bytes"] += int(info.get("result_bytes") or 0)
+                br = info.get("bucket_rows")
+                if br:
+                    if g["bucket_rows"] is None:
+                        g["bucket_rows"] = [0] * len(br)
+                        g["buckets"] = info.get("buckets")
+                    if len(br) == len(g["bucket_rows"]):
+                        g["bucket_rows"] = [a + int(b) for a, b in
+                                            zip(g["bucket_rows"], br)]
+            if not by_key:
+                return
+            store = hints.adaptive_store()
+            for sk, g in by_key.items():
+                fields = {"rows": g["rows"], "bytes": g["bytes"] or None}
+                br = g["bucket_rows"]
+                if br and sum(br) > 0 and g["buckets"]:
+                    hot = max(range(len(br)), key=lambda i: br[i])
+                    fields.update(max_share=round(br[hot] / sum(br), 4),
+                                  hot_bucket=hot,
+                                  nbuckets=int(g["buckets"]))
+                store.observe_by_digest(sk, **fields)
+            store.flush()
+            tracing.counter("adaptive.observed", len(by_key))
+        except Exception:
+            tracing.counter("adaptive.record_failed")
 
     def _live_addrs(self) -> list[str]:
         return [w.addr for w in self.membership.live()]
@@ -472,6 +529,8 @@ class DistributedExecutor:
                 info["kind"] = f.kind
             if f.bucket is not None:
                 info["bucket"] = f.bucket
+            if f.stats_key is not None:
+                info["stats_key"] = f.stats_key
             # dispatch = RPC wall minus what the worker accounted for
             # (execution + dependency fetches): serialization + network +
             # the worker's action-handler queue
@@ -727,11 +786,17 @@ class CoordinatorServer(flight.FlightServerBase):
         planner = DistributedPlanner([w.addr for w in live])
         frags = planner.plan(plan)
         tracing.counter("coordinator.distributed_queries")
+        # reorder decisions from engine.plan's optimize() above ride beside
+        # the fragment-tier broadcast/salt records (docs/adaptive.md)
+        from igloo_tpu.plan.optimizer import last_adaptive_decisions
+        adaptive_info = last_adaptive_decisions() + planner.adaptive_info
         if stream:
-            return self.executor.execute_stream(frags, deadline_s=deadline_s,
-                                                qid=qid, sql=sql)
+            return self.executor.execute_stream(
+                frags, deadline_s=deadline_s, qid=qid, sql=sql,
+                adaptive_info=adaptive_info)
         return self.executor.execute(frags, deadline_s=deadline_s, qid=qid,
-                                     sql=sql)
+                                     sql=sql,
+                                     adaptive_info=adaptive_info)
 
     def _distributable(self, plan) -> bool:
         from igloo_tpu.plan.logical import Scan, walk_plan

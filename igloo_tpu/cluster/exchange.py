@@ -117,22 +117,74 @@ def bucket_ids(table: pa.Table, key_indices: list[int],
 
 
 def partition_table(table: pa.Table, key_indices: list[int],
-                    nbuckets: int) -> list[pa.Table]:
-    """Split `table` into `nbuckets` bucket slices by key hash: ONE stable
-    argsort + boundary slices (zero-copy views of the reordered table),
-    the same shape as GRACE's `_split_by_hash`."""
+                    nbuckets: int,
+                    salt: Optional[tuple] = None) -> list[pa.Table]:
+    """Split `table` into bucket slices by key hash: ONE stable argsort +
+    boundary slices (zero-copy views of the reordered table), the same shape
+    as GRACE's `_split_by_hash`. With `salt` (see `salted_partition`) the
+    result has `nbuckets + salt - 1` slices."""
+    slices, _base = salted_partition(table, key_indices, nbuckets, salt)
+    return slices
+
+
+def salted_partition(table: pa.Table, key_indices: list[int], nbuckets: int,
+                     salt: Optional[tuple] = None
+                     ) -> tuple[list[pa.Table], np.ndarray]:
+    """(bucket slices, BASE per-bucket row counts). `salt` is
+    (hot_bucket, S, role) — the wire fields of a salted `L.Exchange`:
+
+    - role "probe": rows of `hot_bucket` are spread round-robin across
+      {hot_bucket} + S-1 extra buckets (ids nbuckets..nbuckets+S-2); every
+      probe row lands in exactly ONE bucket, so probe-preserving join
+      semantics (INNER/LEFT/SEMI/ANTI with the probe on the preserved side)
+      are untouched.
+    - role "build": rows of `hot_bucket` stay in place AND are replicated
+      into each extra bucket, so every salted fragment sees every build row
+      that could match its probe slice. Only the hot BUCKET replicates —
+      1/nbuckets of the side per extra bucket — which is what makes salting
+      affordable when the build side is too big to broadcast.
+
+    The returned base counts are always for the UNSALTED partitioning: the
+    skew sketch the coordinator records must describe the key distribution,
+    not the salted layout (else one salted run would erase the very skew
+    signal that justified it)."""
+    if salt is not None:
+        hot, s_total, role = salt
+        extra = max(int(s_total) - 1, 0)
+    else:
+        hot, extra, role = None, 0, None
+    total = nbuckets + extra
     if table.num_rows == 0:
-        return [table.slice(0, 0) for _ in range(nbuckets)]
+        return ([table.slice(0, 0) for _ in range(total)],
+                np.zeros(nbuckets, dtype=np.int64))
     pid = bucket_ids(table, key_indices, nbuckets)
+    base_counts = np.bincount(pid, minlength=nbuckets).astype(np.int64)
+    if extra and role == "probe":
+        idx = np.nonzero(pid == hot)[0]
+        r = np.arange(len(idx)) % (extra + 1)
+        pid = pid.copy()
+        pid[idx[r > 0]] = nbuckets + r[r > 0] - 1
+        tracing.counter("exchange.salted")
+        tracing.counter("exchange.salted_rows", len(idx))
+    elif extra and role == "build":
+        rep = np.nonzero(pid == hot)[0]
+        take = np.concatenate([np.arange(table.num_rows, dtype=np.int64)] +
+                              [rep] * extra)
+        pid = np.concatenate(
+            [pid] + [np.full(len(rep), nbuckets + j, dtype=pid.dtype)
+                     for j in range(extra)])
+        table = table.take(take)
+        tracing.counter("exchange.salted")
+        tracing.counter("exchange.salted_rows", len(rep) * extra)
     order = np.argsort(pid, kind="stable")
     sorted_tbl = table.take(order)
-    counts = np.bincount(pid, minlength=nbuckets)
+    counts = np.bincount(pid, minlength=total)
     out, off = [], 0
-    for b in range(nbuckets):
+    for b in range(total):
         c = int(counts[b])
         out.append(sorted_tbl.slice(off, c))
         off += c
-    return out
+    return out, base_counts
 
 
 # --- do_get ticket codec -----------------------------------------------------
@@ -161,12 +213,15 @@ class _Stored:
     schema: pa.Schema
     batches: Optional[list]            # list[pa.RecordBatch]; None = spilled
     nbytes: int
-    nbuckets: Optional[int] = None     # hash-partition bucket count
+    nbuckets: Optional[int] = None     # hash-partition bucket count (incl. salt)
     ranges: Optional[list] = None      # per-bucket (start, count) batch ranges
     meta: Optional[list] = None        # per-bucket {"rows": .., "bytes": ..}
     spill_path: Optional[str] = None
     seq: int = 0                       # insertion order (spill oldest first)
     rows: int = 0
+    # UNSALTED per-bucket row counts: the skew sketch the coordinator
+    # records into AdaptiveStats (salting must not mask the skew signal)
+    base_rows: Optional[list] = None
 
 
 def _chunk(table: pa.Table) -> list:
@@ -204,10 +259,11 @@ class FragmentStore:
     # --- writes ---
 
     def put(self, frag_id: str, table: pa.Table,
-            partition: Optional[tuple[list[int], int]] = None) -> _Stored:
+            partition: Optional[tuple[list[int], int]] = None,
+            salt: Optional[tuple] = None) -> _Stored:
         if partition is not None:
             keys, nb = partition
-            slices = partition_table(table, list(keys), nb)
+            slices, base = salted_partition(table, list(keys), nb, salt)
             batches, ranges, meta = [], [], []
             for s in slices:
                 bs = _chunk(s)
@@ -219,8 +275,9 @@ class FragmentStore:
             tracing.counter("exchange.partition_rows", table.num_rows)
             ent = _Stored(schema=table.schema, batches=batches,
                           nbytes=sum(b.nbytes for b in batches),
-                          nbuckets=nb, ranges=ranges, meta=meta,
-                          rows=table.num_rows)
+                          nbuckets=len(slices), ranges=ranges, meta=meta,
+                          rows=table.num_rows,
+                          base_rows=[int(c) for c in base])
         else:
             batches = _chunk(table)
             ent = _Stored(schema=table.schema, batches=batches,

@@ -41,6 +41,7 @@ from igloo_tpu.cluster import serde
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
+from igloo_tpu.utils import tracing
 
 FRAG_PREFIX = "__frag_"
 
@@ -62,6 +63,10 @@ class QueryFragment:
     schema: Optional[T.Schema] = None
     kind: str = ""                   # "scan" | "exchange" | "join" | "root"
     bucket: Optional[int] = None     # per-bucket join fragment's bucket id
+    # AdaptiveStats digest of the join SIDE this fragment materializes: the
+    # coordinator sums rows/bytes/bucket counts across fragments sharing a
+    # key at query end and records them for the next plan (docs/adaptive.md)
+    stats_key: Optional[str] = None
 
     def is_ready(self, completed: set[str]) -> bool:
         return all(d in completed for d in self.deps)
@@ -87,6 +92,16 @@ def _bucket_scan(frag: "QueryFragment", bucket: int, buckets: int
 def _bucket_union(side_frags: list, bucket: int, buckets: int,
                   schema: T.Schema) -> L.LogicalPlan:
     children = [_bucket_scan(f, bucket, buckets) for f in side_frags]
+    if len(children) == 1:
+        return children[0]
+    u = L.Union(inputs=children)
+    u.schema = schema
+    return u
+
+
+def _whole_union(side_frags: list, schema: T.Schema) -> L.LogicalPlan:
+    """Union of WHOLE fragment results (the broadcast build side)."""
+    children: list[L.LogicalPlan] = [_frag_scan(f) for f in side_frags]
     if len(children) == 1:
         return children[0]
     u = L.Union(inputs=children)
@@ -158,7 +173,19 @@ _DECOMPOSABLE = {E.AggFunc.SUM, E.AggFunc.MIN, E.AggFunc.MAX, E.AggFunc.COUNT,
 
 
 class DistributedPlanner:
-    """Fragments an optimized plan across `workers` (list of addresses)."""
+    """Fragments an optimized plan across `workers` (list of addresses).
+
+    Adaptive decisions (docs/adaptive.md, behind IGLOO_ADAPTIVE=0): when the
+    process-wide AdaptiveStats store holds OBSERVED statistics for a join
+    side (recorded by the coordinator from a previous run of the same side
+    fingerprint), the planner may replace the hash exchange with a
+    BROADCAST plan (replicating the small build side ships fewer bytes than
+    exchanging both sides — the mesh tier's `should_broadcast` rule promoted
+    to the fragment tier) or SALT a pathologically skewed exchange (split
+    the hot bucket's probe rows across extra buckets, replicate the matching
+    build bucket — the escape hatch docs/distributed.md used to document as
+    unwinnable). First runs carry no observations and keep the plain
+    exchange shape, so behavior only changes once telemetry justifies it."""
 
     def __init__(self, workers: list[str], partitions_per_worker: int = 1,
                  shuffle_buckets: Optional[int] = None):
@@ -175,6 +202,11 @@ class DistributedPlanner:
         # kill switch for A/B against the union-onto-one-worker plan shape
         self.shuffle_enabled = \
             os.environ.get("IGLOO_SHUFFLE_JOIN", "1") != "0"
+        from igloo_tpu.exec.hints import adaptive_enabled
+        self.adaptive_enabled = adaptive_enabled()
+        # per-join decision records, published into last_metrics["adaptive"]
+        # and the sweep JSON so every plan choice is attributable
+        self.adaptive_info: list[dict] = []
 
     def plan(self, plan: L.LogicalPlan) -> list[QueryFragment]:
         """-> fragments in dependency-safe order; the LAST one is the root."""
@@ -193,7 +225,8 @@ class DistributedPlanner:
                        deps: Optional[list[str]] = None,
                        worker: Optional[str] = None,
                        kind: str = "",
-                       bucket: Optional[int] = None) -> QueryFragment:
+                       bucket: Optional[int] = None,
+                       stats_key: Optional[str] = None) -> QueryFragment:
         plan_json = serde.plan_to_json(plan)
         if deps is None:
             # dedupe, preserving order: a per-bucket join fragment references
@@ -205,7 +238,7 @@ class DistributedPlanner:
         f = QueryFragment(id=uuid.uuid4().hex[:12], plan=plan_json,
                           worker=worker or self._next_worker(),
                           deps=deps, schema=plan.schema, kind=kind,
-                          bucket=bucket)
+                          bucket=bucket, stats_key=stats_key)
         frags_out.append(f)
         return f
 
@@ -265,20 +298,196 @@ class DistributedPlanner:
                     lk.dtype.id is not rk.dtype.id:
                 return None
         B = self.shuffle_buckets
-        left_frags = self._exchange_fragments(p.left, lkeys, B, frags)
-        right_frags = self._exchange_fragments(p.right, rkeys, B, frags)
+        lkey, rkey, lobs, robs = self._side_observations(p)
+        # --- broadcast-vs-shuffle switch (observed stats only) ---
+        bcast = self._choose_broadcast(p, lobs, robs)
+        if bcast is not None:
+            return self._broadcast_join(p, frags, bcast, lkey, rkey)
+        # --- hot-key salting of a pathologically skewed exchange ---
+        salt = self._choose_salt(p, B, lobs, robs)
+        lsalt = rsalt = None
+        if salt is not None:
+            hot, S, probe_left = salt
+            lsalt = (hot, S, "probe" if probe_left else "build")
+            rsalt = (hot, S, "build" if probe_left else "probe")
+            B_total = B + S - 1
+        else:
+            B_total = B
+        left_frags = self._exchange_fragments(p.left, lkeys, B, frags,
+                                              stats_key=lkey, salt=lsalt)
+        right_frags = self._exchange_fragments(p.right, rkeys, B, frags,
+                                               stats_key=rkey, salt=rsalt)
         join_scans: list[L.LogicalPlan] = []
-        for b in range(B):
-            jb = L.Join(left=_bucket_union(left_frags, b, B, p.left.schema),
-                        right=_bucket_union(right_frags, b, B, p.right.schema),
+        W = len(self.workers)
+        for b in range(B_total):
+            jb = L.Join(left=_bucket_union(left_frags, b, B_total,
+                                           p.left.schema),
+                        right=_bucket_union(right_frags, b, B_total,
+                                            p.right.schema),
                         join_type=p.join_type,
                         left_keys=[_copy_expr(k) for k in p.left_keys],
                         right_keys=[_copy_expr(k) for k in p.right_keys],
                         residual=_copy_expr(p.residual))
             jb.schema = p.schema
-            jf = self._make_fragment(
-                jb, frags, worker=self.workers[b % len(self.workers)],
-                kind="join", bucket=b)
+            if salt is not None and b >= B:
+                # salted extra buckets hold slices of the HOT bucket's work:
+                # rotate them onto workers AFTER the hot bucket's own, or
+                # the split re-serializes on one worker
+                worker = self.workers[(salt[0] + 1 + (b - B)) % W]
+            else:
+                worker = self.workers[b % W]
+            jf = self._make_fragment(jb, frags, worker=worker,
+                                     kind="join", bucket=b)
+            join_scans.append(_frag_scan(jf))
+        if salt is None and self.adaptive_enabled:
+            self.adaptive_info.append({
+                "strategy": "shuffle", "buckets": B,
+                "adaptive_source": "observed" if (lobs or robs)
+                else "estimated"})
+        if len(join_scans) == 1:
+            return join_scans[0]
+        u = L.Union(inputs=join_scans)
+        u.schema = p.schema
+        return u
+
+    # --- adaptive decisions (docs/adaptive.md) ---
+
+    def _side_observations(self, p: L.Join):
+        """(left digest, right digest, left obs, right obs) for the join's
+        side fingerprints; digests tag this query's fragments so the
+        coordinator records what actually happened under the same keys the
+        NEXT planning reads."""
+        if not self.adaptive_enabled:
+            return None, None, None, None
+        from igloo_tpu.exec.hints import adaptive_store, digest_key, plan_fp
+        store = adaptive_store()
+        out = []
+        for side in (p.left, p.right):
+            fp = plan_fp(side)
+            if fp is None:
+                out.extend([None, None])
+            else:
+                out.extend([digest_key(fp), store.observed(fp)])
+        if out[0] is not None and out[0] == out[2]:
+            # self-join: both sides share one fingerprint, so per-side
+            # recording would SUM the two sides into one record (2x rows,
+            # merged sketches) — a systematic bias, not tolerable staleness.
+            # Skip observation and recording for this join entirely.
+            return None, None, None, None
+        return out[0], out[2], out[1], out[3]
+
+    @staticmethod
+    def _replicable(jt: JoinType, build_left: bool) -> bool:
+        """True when replicating the build side cannot duplicate output:
+        build-side unmatched rows are never emitted for these types, and
+        probe rows still appear exactly once (same validity rule as the mesh
+        tier's broadcast join, parallel/shuffle.py)."""
+        if jt is JoinType.INNER:
+            return True
+        if jt is JoinType.LEFT:
+            return not build_left
+        if jt is JoinType.RIGHT:
+            return build_left
+        if jt in (JoinType.SEMI, JoinType.ANTI):
+            return not build_left   # build is always the right side
+        return False                # FULL: both sides preserved
+
+    @staticmethod
+    def _obs_bytes(side: L.LogicalPlan, obs: Optional[dict]) -> Optional[int]:
+        """Observed side size in bytes: exchange result bytes when recorded,
+        else observed rows x estimated row width."""
+        if not obs:
+            return None
+        if obs.get("bytes"):
+            return int(obs["bytes"])
+        if obs.get("rows") is not None:
+            from igloo_tpu.exec.hints import row_width_bytes
+            return int(obs["rows"]) * row_width_bytes(side.schema.fields)
+        return None
+
+    def _choose_broadcast(self, p: L.Join, lobs, robs) -> Optional[str]:
+        """"left"/"right" build side to replicate, or None. Fires only on
+        OBSERVED sizes: replicating on a bad estimate ships build x W bytes,
+        while a missed broadcast merely keeps the exchange — asymmetric risk,
+        so the first run always observes."""
+        if not self.adaptive_enabled:
+            return None
+        lb = self._obs_bytes(p.left, lobs)
+        rb = self._obs_bytes(p.right, robs)
+        if lb is None or rb is None:
+            return None
+        W = len(self.workers)
+        floor = 64 * 1024 * W  # tiny build sides always broadcast
+        cand = []
+        if self._replicable(p.join_type, True) and \
+                lb * (W - 1) <= max(rb, floor):
+            cand.append(("left", lb))
+        if self._replicable(p.join_type, False) and \
+                rb * (W - 1) <= max(lb, floor):
+            cand.append(("right", rb))
+        if not cand:
+            return None
+        return min(cand, key=lambda c: c[1])[0]
+
+    def _choose_salt(self, p: L.Join, B: int, lobs, robs):
+        """(hot_bucket, S, probe_is_left) when one side's skew sketch crossed
+        the pathological bound at THIS bucket count and the other side may
+        replicate, else None."""
+        if not self.adaptive_enabled or B < 2:
+            return None
+        from igloo_tpu.parallel.shuffle import pathological_share
+        bound = pathological_share(B)
+        env = os.environ.get("IGLOO_SALT_BUCKETS")
+        S = int(env) if env else max(2, len(self.workers))
+        for obs, probe_left in ((lobs, True), (robs, False)):
+            if not obs or obs.get("max_share") is None or \
+                    obs.get("hot_bucket") is None:
+                continue
+            if obs.get("nbuckets") != B:
+                continue  # sketch taken at another bucket count: not mappable
+            if obs["max_share"] <= bound:
+                continue
+            if not self._replicable(p.join_type, build_left=not probe_left):
+                continue
+            self.adaptive_info.append({
+                "strategy": "salted", "buckets": B, "salt": S,
+                "hot_bucket": int(obs["hot_bucket"]),
+                "probe": "left" if probe_left else "right",
+                "max_share": round(float(obs["max_share"]), 4),
+                "adaptive_source": "observed"})
+            tracing.counter("adaptive.salted")
+            return int(obs["hot_bucket"]), S, probe_left
+        return None
+
+    def _broadcast_join(self, p: L.Join, frags: list[QueryFragment],
+                        build_side: str, lkey, rkey) -> L.LogicalPlan:
+        """Replicate the build side instead of exchanging both: probe scan
+        fragments keep their data in place, one join fragment per probe
+        fragment runs CO-LOCATED with it and fetches the (small) build
+        result — the only bytes that move."""
+        build_left = build_side == "left"
+        build = p.left if build_left else p.right
+        probe = p.right if build_left else p.left
+        build_frags = self._side_fragments(
+            build, frags, stats_key=lkey if build_left else rkey)
+        probe_frags = self._side_fragments(
+            probe, frags, stats_key=rkey if build_left else lkey)
+        tracing.counter("adaptive.broadcast")
+        self.adaptive_info.append({
+            "strategy": "broadcast", "build": build_side,
+            "probe_fragments": len(probe_frags),
+            "adaptive_source": "observed"})
+        join_scans: list[L.LogicalPlan] = []
+        for pf in probe_frags:
+            bunion = _whole_union(build_frags, build.schema)
+            pscan = _frag_scan(pf)
+            left, right = (bunion, pscan) if build_left else (pscan, bunion)
+            jb = L.Join(left=left, right=right, join_type=p.join_type,
+                        left_keys=[_copy_expr(k) for k in p.left_keys],
+                        right_keys=[_copy_expr(k) for k in p.right_keys],
+                        residual=_copy_expr(p.residual))
+            jb.schema = p.schema
+            jf = self._make_fragment(jb, frags, worker=pf.worker, kind="join")
             join_scans.append(_frag_scan(jf))
         if len(join_scans) == 1:
             return join_scans[0]
@@ -286,17 +495,38 @@ class DistributedPlanner:
         u.schema = p.schema
         return u
 
+    def _side_fragments(self, side: L.LogicalPlan,
+                        frags: list[QueryFragment],
+                        stats_key: Optional[str] = None
+                        ) -> list[QueryFragment]:
+        """Plain (un-exchanged) fragments for a join side, one per scan
+        partition set."""
+        out = []
+        for part in self._partition_sets(side):
+            sub = _with_partition(side, part) if part else L.copy_plan(side)
+            out.append(self._make_fragment(sub, frags, deps=[], kind="scan",
+                                           stats_key=stats_key))
+        return out
+
     def _exchange_fragments(self, side: L.LogicalPlan, keys: list[int],
                             buckets: int,
-                            frags: list[QueryFragment]) -> list[QueryFragment]:
-        """One Exchange-rooted fragment per scan partition set of `side`."""
+                            frags: list[QueryFragment],
+                            stats_key: Optional[str] = None,
+                            salt: Optional[tuple] = None
+                            ) -> list[QueryFragment]:
+        """One Exchange-rooted fragment per scan partition set of `side`.
+        `salt` = (hot_bucket, S, role) adds the salted-bucket spread/
+        replication at the worker's partition step (cluster/exchange.py)."""
         out = []
         for part in self._partition_sets(side):
             sub = _with_partition(side, part) if part else L.copy_plan(side)
             ex = L.Exchange(input=sub, keys=list(keys), buckets=buckets)
+            if salt is not None:
+                ex.salt_bucket, ex.salt, ex.salt_role = salt
             ex.schema = sub.schema
             out.append(self._make_fragment(ex, frags, deps=[],
-                                           kind="exchange"))
+                                           kind="exchange",
+                                           stats_key=stats_key))
         return out
 
     def _scan_fragments(self, subtree: L.LogicalPlan,

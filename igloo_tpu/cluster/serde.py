@@ -209,6 +209,9 @@ def plan_to_json(p: L.LogicalPlan) -> dict:
     elif isinstance(p, L.Exchange):
         d.update(input=plan_to_json(p.input), keys=list(p.keys),
                  buckets=p.buckets)
+        if p.salt_role is not None:
+            d.update(salt_bucket=p.salt_bucket, salt=p.salt,
+                     salt_role=p.salt_role)
     else:
         raise PlanError(f"cannot serialize plan node {type(p).__name__}")
     return d
@@ -276,7 +279,10 @@ def plan_from_json(d: dict, catalog) -> L.LogicalPlan:
         p = L.Values(rows=[list(r) for r in d["rows"]])
     elif t == "Exchange":
         p = L.Exchange(input=plan_from_json(d["input"], catalog),
-                       keys=list(d["keys"]), buckets=d["buckets"])
+                       keys=list(d["keys"]), buckets=d["buckets"],
+                       salt_bucket=d.get("salt_bucket"),
+                       salt=d.get("salt", 1),
+                       salt_role=d.get("salt_role"))
     else:
         raise PlanError(f"cannot deserialize plan node {t}")
     p.schema = schema

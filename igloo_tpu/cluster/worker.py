@@ -197,21 +197,29 @@ class WorkerServer(flight.FlightServerBase):
             dep_s = time.perf_counter() - t_dep0
             catalog = _OverlayCatalog(self._catalog, overlay)
             plan = serde.plan_from_json(req["plan"], catalog)
-            partition = None
+            partition = salt = None
             if isinstance(plan, L.Exchange):
                 # fragment-root exchange: execute the input, hash-partition
-                # the result at store time (per-bucket slices + metadata)
+                # the result at store time (per-bucket slices + metadata);
+                # a salted exchange spreads/replicates the flagged hot
+                # bucket (docs/adaptive.md)
                 partition = (plan.keys, plan.buckets)
+                if plan.salt_role is not None:
+                    salt = (plan.salt_bucket, plan.salt, plan.salt_role)
                 plan = plan.input
             t0 = time.perf_counter()
             table = self._executor().execute_to_arrow(plan)
             elapsed = time.perf_counter() - t0
-            self._store.put(frag_id, table, partition=partition)
+            ent = self._store.put(frag_id, table, partition=partition,
+                                  salt=salt)
         tracing.counter("worker.fragments")
         out = {"id": frag_id, "rows": table.num_rows,
                "elapsed_s": round(elapsed, 6), "worker": self.worker_id,
                "dep_fetch_s": round(dep_s, 6),
                "input_rows": input_rows,
+               # Arrow bytes of the stored result: the coordinator's
+               # adaptive recording sums these per join side
+               "result_bytes": ent.nbytes,
                "h2d_bytes": delta.get("xfer.h2d_bytes"),
                "d2h_bytes": delta.get("xfer.d2h_bytes"),
                "jit_misses": delta.get("jit.miss"),
@@ -220,6 +228,11 @@ class WorkerServer(flight.FlightServerBase):
                "exchange_bytes": delta.get("exchange.fetch_bytes")}
         if partition is not None:
             out["buckets"] = partition[1]
+            # UNSALTED per-bucket rows: the coordinator's skew sketch must
+            # see the key distribution, not the salted layout
+            out["bucket_rows"] = ent.base_rows
+            if salt is not None:
+                out["salted"] = True
         return out
 
     # --- Flight surface ---

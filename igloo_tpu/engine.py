@@ -24,7 +24,7 @@ from igloo_tpu.errors import CatalogError, IglooError, PlanError
 from igloo_tpu.exec.executor import Executor
 from igloo_tpu.plan import logical as L
 from igloo_tpu.plan.binder import Binder
-from igloo_tpu.plan.optimizer import optimize
+from igloo_tpu.plan.optimizer import last_adaptive_decisions, optimize
 from igloo_tpu.sql import ast as A
 from igloo_tpu.sql.parser import parse_sql
 from igloo_tpu.utils import stats, tracing
@@ -173,6 +173,13 @@ class QueryEngine:
             bound = Binder(self.catalog, udfs=self.udfs).bind(stmt.query)
             plan = optimize(bound)
             text = L.plan_tree_str(plan)
+            for d in last_adaptive_decisions():
+                # adaptive reorder attribution (docs/adaptive.md): which
+                # greedy order won and whether observations or estimates
+                # drove it
+                text += (f"\n-- adaptive: strategy={d['strategy']} "
+                         f"join_order={d['join_order']} "
+                         f"adaptive_source={d['adaptive_source']}")
             qs = None
             if stmt.analyze:
                 # EXPLAIN ANALYZE executes through the SAME routing ladder as
@@ -183,6 +190,7 @@ class QueryEngine:
                 with stats.collect(sql, detail=True) as qs:
                     table = self._execute_plan(plan)
                     qs.rows = table.num_rows
+                self._harvest_adaptive(qs, plan)
                 text += "\n-- actual (operator tree):\n"
                 text += stats.render_tree(qs)
                 delta = qs.counters
@@ -221,9 +229,45 @@ class QueryEngine:
             with stats.collect(sql) as qs:
                 table, plan = self._run_select(stmt, want_plan=True)
                 qs.rows = table.num_rows
+            self._harvest_adaptive(qs, plan)
             return QueryResult(table, plan=plan,
                                elapsed_s=time.perf_counter() - t0, stats=qs)
         raise IglooError(f"unsupported statement {type(stmt).__name__}")
+
+    def _harvest_adaptive(self, qs: Optional[stats.QueryStats],
+                          plan: Optional[L.LogicalPlan]) -> None:
+        """Fold a finished query's free cardinality observations into the
+        process-wide AdaptiveStats store (docs/adaptive.md): per-subtree
+        observed rows, the root cardinality, and — when a join AND both of
+        its inputs were observed in this query — the join's input total, so
+        selectivity is derivable. Best-effort by contract: stale or missing
+        stats mis-route plans, never break them."""
+        from igloo_tpu.exec import hints
+        if qs is None or not hints.adaptive_enabled():
+            return
+        obs = {k: n for k, n in qs.observations if k is not None}
+        if plan is not None and qs.rows is not None:
+            fp = hints.plan_fp(plan)
+            if fp is not None:
+                obs[fp] = int(qs.rows)
+        if not obs:
+            return
+        # the CURRENT process-wide store, not one cached at engine
+        # construction: reset_adaptive_store() (tests) would otherwise leave
+        # a long-lived engine recording into a store no planner reads
+        store = hints.adaptive_store()
+        for k, n in obs.items():
+            store.observe(k, rows=n)
+        if plan is not None:
+            for node in L.walk_plan(plan):
+                if isinstance(node, L.Join):
+                    jf = hints.plan_fp(node)
+                    lf = hints.plan_fp(node.left)
+                    rf = hints.plan_fp(node.right)
+                    if jf in obs and lf in obs and rf in obs:
+                        store.observe(jf, in_rows=obs[lf] + obs[rf])
+        store.flush()
+        tracing.counter("adaptive.observed", len(obs))
 
     def _resolve_mesh(self):
         """The execution mesh, resolved once: None for single-device."""

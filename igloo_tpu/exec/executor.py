@@ -432,8 +432,17 @@ class Executor:
             out = m(plan)
             if stats.detail_active():
                 # EXPLAIN ANALYZE: actual row count, one device sync per op
-                stats.set_rows(out.num_live())
+                n = out.num_live()
+                stats.set_rows(n)
                 stats.annotate(capacity=out.capacity)
+                if not isinstance(plan, L.Scan):
+                    # the sync is already paid for: feed the adaptive planner
+                    # loop (docs/adaptive.md) — EXPLAIN ANALYZE doubles as
+                    # the device tier's cardinality profiler
+                    from igloo_tpu.exec.hints import plan_fp
+                    fp = plan_fp(plan)
+                    if fp is not None:
+                        stats.observe_card(fp, n)
         if out.schema is not plan.schema and out.schema != plan.schema:
             # keep plan schema authoritative (names may differ from kernel output)
             out = DeviceBatch(plan.schema, out.columns, out.live)
@@ -844,6 +853,7 @@ class Executor:
             if self._hints is not None:
                 self._hints.put(key, n)
                 self._hints.flush()
+            stats.observe_card(fp, n)  # sync already paid: adaptive loop
             return self._maybe_shrink(batch, known_live=n)
         want = round_capacity(max(hint, 1))
         # factor 2, not _SHRINK_FACTOR: past the compile budget every halving

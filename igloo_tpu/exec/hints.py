@@ -1,16 +1,32 @@
-"""Persistent cardinality-hint store for adaptive fused execution.
+"""Persistent per-plan-fingerprint stores for adaptive execution.
 
-The fused compiler (exec/fused.py) sizes intermediate compactions from
-observed live counts. In-memory hints die with the process, which would make
-every fresh process pay the un-hinted full-width program AND a second XLA
-compile once hints arrive. Persisting them beside the XLA compilation cache
-means a new process compiles the hinted program directly — and hits the
-persistent XLA cache for it.
+Two stores share one digest-keyed JSON-file idiom:
 
-Keys are structural node fingerprints (nested tuples); they are stored under a
-stable content hash of their repr. A hash collision or stale entry can only
-mis-SIZE a compaction, never corrupt a result: the in-program overflow flag
-triggers an exact repair re-run (see FusedCompiler._adaptive)."""
+- `HintStore` (PR1/round-4 era): flat int live-count hints for the fused /
+  staged compilers' in-program compactions, keyed by *compiler-internal*
+  fingerprints (exec/fused.py hfps, exec/executor.py slive keys).
+- `AdaptiveStats` (the telemetry->planner feedback loop, docs/adaptive.md):
+  per-*logical-subtree* observed execution statistics — output cardinality,
+  join input rows (selectivity), exchange result bytes, and a top-bucket skew
+  sketch — keyed by `plan_fp` structural fingerprints. Planners consume them:
+  join reordering (plan/optimizer.py), broadcast-vs-shuffle switching
+  (cluster/fragment.py), hot-key salting (cluster/exchange.py), and the mesh
+  tier's broadcast rule (parallel/executor.py).
+
+Safety contract (both stores): keys are structural fingerprints stored under
+a stable content hash of their repr. A hash collision or stale entry can only
+mis-SIZE or mis-ROUTE a plan choice — pick a worse join order, broadcast or
+salt when it no longer pays — never corrupt a result: every consumer's
+output is semantics-preserving for any stats value, and in-program
+compactions keep their overflow-flag exact-repair path
+(FusedCompiler._adaptive). Note that scan fingerprints key by table NAME +
+pushed filters + partition, not content: re-registering different data
+under the same name keeps old entries, which — by the same contract — can
+only mis-route plans until fresh observations overwrite them.
+
+Persisting beside the XLA compilation cache means a new process plans from
+the cluster's observed history directly — and hits the persistent XLA cache
+for the programs those plans compile to."""
 from __future__ import annotations
 
 import hashlib
@@ -20,29 +36,72 @@ import tempfile
 import threading
 from typing import Optional
 
-# lock discipline (checked by igloo-lint lock-discipline): one HintStore is
-# shared by every executor the engine builds, and `put`/`flush` run both on
-# the query thread and on the GRACE prefetch thread; `_data`/`_dirty`
-# read-modify-writes must hold the store lock
+# lock discipline (checked by igloo-lint lock-discipline): one store instance
+# is shared by every executor the engine builds, and `put`/`observe`/`flush`
+# run both on the query thread and on worker threads (GRACE prefetch, Flight
+# RPC handlers); `_data`/`_dirty` read-modify-writes must hold the store lock
 _GUARDED_BY = {"_lock": ("_data", "_dirty")}
+
+#: kill switch for the whole telemetry->planner loop: IGLOO_ADAPTIVE=0
+#: reproduces pre-adaptive plans (join order, exchange shape) exactly
+ADAPTIVE_ENV = "IGLOO_ADAPTIVE"
+
+
+def adaptive_enabled() -> bool:
+    return os.environ.get(ADAPTIVE_ENV, "1") != "0"
 
 
 def _digest(key) -> str:
     return hashlib.sha1(repr(key).encode()).hexdigest()
 
 
-class HintStore:
+def digest_key(key) -> str:
+    """Public stable digest of a fingerprint key — what rides the wire when a
+    planner tags fragments for the coordinator's end-of-query recording."""
+    return _digest(key)
+
+
+class _JsonStore:
+    """Digest-keyed JSON-file store base: atomic flush, never fails a query."""
+
     def __init__(self, path: Optional[str]):
         self._path = path
         self._lock = threading.Lock()
-        self._data: dict[str, int] = {}
+        self._data: dict = {}
         self._dirty = False
         if path and os.path.exists(path):
             try:
                 with open(path) as f:
-                    self._data = {k: int(v) for k, v in json.load(f).items()}
+                    self._data = self._coerce(json.load(f))
             except Exception:
                 self._data = {}
+
+    def _coerce(self, raw: dict) -> dict:  # subclass value validation
+        return dict(raw)
+
+    def flush(self) -> None:
+        # the file write stays INSIDE the lock: two racing flushes (query
+        # thread + GRACE prefetch thread) could otherwise os.replace an older
+        # snapshot over a newer one, silently dropping a just-adopted entry
+        with self._lock:
+            if not self._dirty or not self._path:
+                return
+            self._dirty = False
+            try:
+                os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self._path))
+                with os.fdopen(fd, "w") as f:
+                    json.dump(self._data, f)
+                os.replace(tmp, self._path)
+            except Exception:
+                pass  # stats are an optimization; never fail a query on them
+
+
+class HintStore(_JsonStore):
+    """Flat int hints (live counts / sentinels) for the fused/staged tiers."""
+
+    def _coerce(self, raw: dict) -> dict:
+        return {k: int(v) for k, v in raw.items()}
 
     def get(self, key) -> Optional[int]:
         with self._lock:
@@ -60,22 +119,135 @@ class HintStore:
             if self._data.pop(_digest(key), None) is not None:
                 self._dirty = True
 
-    def flush(self) -> None:
-        # the file write stays INSIDE the lock: two racing flushes (query
-        # thread + GRACE prefetch thread) could otherwise os.replace an older
-        # snapshot over a newer one, silently dropping a just-adopted hint
+
+class AdaptiveStats(_JsonStore):
+    """Observed execution statistics per logical-subtree fingerprint.
+
+    Record fields (all optional, merged per observation):
+      rows       observed output cardinality of the subtree
+      in_rows    sum of join input cardinalities (rows/in_rows = selectivity)
+      bytes      observed Arrow result bytes (exchange fragments)
+      max_share  top-bucket share of the subtree's hash exchange (skew sketch,
+                 from the fragment store's existing per-bucket rows metadata)
+      hot_bucket index of that top bucket
+      nbuckets   bucket count the sketch was taken at (a sketch only guides
+                 salting when the current plan uses the same bucket count —
+                 the hash is deterministic per count, not across counts)
+    """
+
+    _FIELDS = ("rows", "in_rows", "bytes", "max_share", "hot_bucket",
+               "nbuckets")
+
+    def _coerce(self, raw: dict) -> dict:
+        out = {}
+        for k, v in raw.items():
+            if isinstance(v, dict):
+                out[k] = {f: v[f] for f in self._FIELDS if f in v}
+        return out
+
+    # NOTE: `observed`/`observed_rows` return raw data-dependent values —
+    # they are taint SOURCES for the igloo-lint jit-key checker: their
+    # results must never reach a _jitted fingerprint unquantized (they drive
+    # plan-structure and routing choices, not program shapes).
+    def observed(self, key) -> Optional[dict]:
         with self._lock:
-            if not self._dirty or not self._path:
-                return
-            self._dirty = False
-            try:
-                os.makedirs(os.path.dirname(self._path), exist_ok=True)
-                fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self._path))
-                with os.fdopen(fd, "w") as f:
-                    json.dump(self._data, f)
-                os.replace(tmp, self._path)
-            except Exception:
-                pass  # hints are an optimization; never fail a query on them
+            rec = self._data.get(_digest(key))
+            return dict(rec) if rec is not None else None
+
+    def observed_rows(self, key) -> Optional[int]:
+        rec = self.observed(key)
+        v = rec.get("rows") if rec else None
+        return int(v) if v is not None else None
+
+    def selectivity(self, key) -> Optional[float]:
+        """Observed rows-out / rows-in, when both were recorded."""
+        rec = self.observed(key)
+        if not rec or not rec.get("in_rows") or rec.get("rows") is None:
+            return None
+        return rec["rows"] / rec["in_rows"]
+
+    def observe(self, key, **fields) -> None:
+        self.observe_by_digest(_digest(key), **fields)
+
+    def observe_by_digest(self, digest: str, **fields) -> None:
+        """Merge non-None fields into the record (last observation wins —
+        stale values can only mis-route, see module docstring)."""
+        clean = {k: v for k, v in fields.items()
+                 if k in self._FIELDS and v is not None}
+        if not clean:
+            return
+        with self._lock:
+            rec = self._data.get(digest)
+            if rec is None:
+                rec = {}
+                self._data[digest] = rec
+            for k, v in clean.items():
+                if rec.get(k) != v:
+                    rec[k] = v
+                    self._dirty = True
+
+    def remove(self, key) -> None:
+        with self._lock:
+            if self._data.pop(_digest(key), None) is not None:
+                self._dirty = True
+
+
+def row_width_bytes(schema) -> int:
+    """Estimated bytes per row for observed-rows -> bytes conversion. The
+    join reorder (plan/optimizer.py) and the broadcast switch
+    (cluster/fragment.py) must agree on what a row weighs, so the heuristic
+    lives here, next to the store both read."""
+    return max(8, sum(16 if f.dtype.is_string else 8 for f in schema))
+
+
+# --- structural plan fingerprints -------------------------------------------
+
+
+def plan_fp(plan):
+    """Projection-INSENSITIVE structural fingerprint of a logical subtree:
+    expressions repr by column NAME (not index), scans by (table, filters,
+    partition). The same logical work keys the same entry whether observed
+    pre- or post-pruning, on the host tier, the device tier, or a cluster
+    fragment. Returns None for shapes with no stable key (subqueries,
+    windows, unions...). Shared by the host tier's structural memo and every
+    AdaptiveStats producer/consumer."""
+    from igloo_tpu.plan import logical as L
+
+    def xr(x) -> Optional[str]:
+        # exprs repr by name; a nested subquery reprs as the OPAQUE
+        # "subquery(...)" (two different subqueries would collide) ->
+        # poison the fingerprint
+        r = repr(x)
+        return None if "subquery(" in r or "exists(" in r else r
+
+    t = type(plan)
+    if t is L.Scan:
+        fr = xr(plan.pushed_filters)
+        return fr and ("scan", plan.table, fr, plan.partition)
+    if t is L.Filter:
+        sub = plan_fp(plan.input)
+        pr = xr(plan.predicate)
+        return sub and pr and ("filter", pr, sub)
+    if t is L.Project:
+        sub = plan_fp(plan.input)
+        er = xr(plan.exprs)
+        return sub and er and ("proj", er, tuple(plan.names), sub)
+    if t is L.Join:
+        ls, rs = plan_fp(plan.left), plan_fp(plan.right)
+        kr = xr((plan.left_keys, plan.right_keys, plan.residual))
+        return ls and rs and kr and (
+            "join", plan.join_type.value, kr, ls, rs)
+    if t is L.Aggregate:
+        sub = plan_fp(plan.input)
+        ar = xr((plan.group_exprs, plan.aggs))
+        return sub and ar and ("agg", ar, tuple(plan.agg_names), sub)
+    if t is L.Distinct:
+        sub = plan_fp(plan.input)
+        return sub and ("distinct", sub)
+    return None  # unbounded/unhandled shapes: no stable key
+
+
+# --- default instances -------------------------------------------------------
 
 
 def default_store() -> HintStore:
@@ -84,3 +256,34 @@ def default_store() -> HintStore:
     cache_dir = compile_cache.active_dir()
     return HintStore(os.path.join(cache_dir, "nhints.json")
                      if cache_dir else None)
+
+
+_adaptive_singleton_lock = threading.Lock()
+_adaptive_singleton: Optional[AdaptiveStats] = None
+
+ADAPTIVE_PATH_ENV = "IGLOO_ADAPTIVE_STATS"
+
+
+def adaptive_store() -> AdaptiveStats:
+    """Process-wide AdaptiveStats: engine, coordinator planner, and mesh tier
+    all feed and read ONE store. Path precedence: IGLOO_ADAPTIVE_STATS env >
+    beside the persistent XLA cache > in-memory only (still adaptive within
+    the process; nothing persists)."""
+    global _adaptive_singleton
+    with _adaptive_singleton_lock:
+        if _adaptive_singleton is None:
+            path = os.environ.get(ADAPTIVE_PATH_ENV)
+            if path is None:
+                from igloo_tpu import compile_cache
+                cache_dir = compile_cache.active_dir()
+                if cache_dir:
+                    path = os.path.join(cache_dir, "adaptive_stats.json")
+            _adaptive_singleton = AdaptiveStats(path or None)
+        return _adaptive_singleton
+
+
+def reset_adaptive_store() -> None:
+    """Drop the process singleton (tests re-point IGLOO_ADAPTIVE_STATS)."""
+    global _adaptive_singleton
+    with _adaptive_singleton_lock:
+        _adaptive_singleton = None

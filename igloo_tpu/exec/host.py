@@ -192,6 +192,16 @@ class HostExecutor:
             # numpy row counts are host values: actual rows are FREE on this
             # tier, recorded at every collection level
             stats.set_rows(out.n)
+        if not isinstance(plan, L.Scan):
+            # feed the adaptive planner loop (docs/adaptive.md): the count is
+            # already in hand, so the observation is one tuple append. The
+            # fingerprint recursion is the real cost on this sub-0.1s tier,
+            # so it only runs when the loop is on and no memo key exists
+            from igloo_tpu.exec.hints import adaptive_enabled
+            if adaptive_enabled():
+                fp = key if key is not None else self._plan_fp(plan)
+                if fp is not None:
+                    stats.observe_card(fp, out.n)
         if out.schema is not plan.schema and out.schema != plan.schema:
             out = HBatch(plan.schema, out.cols, out.n)
         if key is not None and (key not in self._memo or
@@ -202,43 +212,13 @@ class HostExecutor:
 
     @classmethod
     def _plan_fp(cls, plan: L.LogicalPlan):
-        """Projection-INSENSITIVE structural fingerprint: expressions repr by
-        column NAME (not index), scans by (table, filters, partition). A
-        scalar subquery's join subtree then hits the outer query's memo entry
-        even though pruning gave it a narrower scan, and the hit is served by
+        """Projection-INSENSITIVE structural fingerprint (exec/hints.plan_fp,
+        shared with every AdaptiveStats producer/consumer): a scalar
+        subquery's join subtree then hits the outer query's memo entry even
+        though pruning gave it a narrower scan, and the hit is served by
         name (_serve_by_name) — TPC-H q2/q11/q15/q22 halve."""
-        def xr(x) -> Optional[str]:
-            # exprs repr by name; a nested subquery reprs as the OPAQUE
-            # "subquery(...)" (two different subqueries would collide) ->
-            # poison the fingerprint
-            r = repr(x)
-            return None if "subquery(" in r or "exists(" in r else r
-
-        t = type(plan)
-        if t is L.Scan:
-            fr = xr(plan.pushed_filters)
-            return fr and ("scan", plan.table, fr, plan.partition)
-        if t is L.Filter:
-            sub = cls._plan_fp(plan.input)
-            pr = xr(plan.predicate)
-            return sub and pr and ("filter", pr, sub)
-        if t is L.Project:
-            sub = cls._plan_fp(plan.input)
-            er = xr(plan.exprs)
-            return sub and er and ("proj", er, tuple(plan.names), sub)
-        if t is L.Join:
-            ls, rs = cls._plan_fp(plan.left), cls._plan_fp(plan.right)
-            kr = xr((plan.left_keys, plan.right_keys, plan.residual))
-            return ls and rs and kr and (
-                "join", plan.join_type.value, kr, ls, rs)
-        if t is L.Aggregate:
-            sub = cls._plan_fp(plan.input)
-            ar = xr((plan.group_exprs, plan.aggs))
-            return sub and ar and ("agg", ar, tuple(plan.agg_names), sub)
-        if t is L.Distinct:
-            sub = cls._plan_fp(plan.input)
-            return sub and ("distinct", sub)
-        return None  # unbounded/unhandled shapes: no memo
+        from igloo_tpu.exec.hints import plan_fp
+        return plan_fp(plan)
 
     # ---- leaves ----------------------------------------------------------
 

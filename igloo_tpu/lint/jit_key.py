@@ -40,8 +40,14 @@ SANITIZERS = {"round_capacity", "canonical_capacity",
               "canonical_direct_table",
               "choose_match_capacity", "batch_proto_key", "len"}
 
-# attribute-call names that produce data-dependent scalars
-_SOURCE_METHODS = {"num_live", "item", "device_get"}
+# attribute-call names that produce data-dependent scalars. The adaptive
+# stats accessors (exec/hints.AdaptiveStats) are sources by design: observed
+# cardinalities/selectivities drive plan-STRUCTURE and routing choices, and
+# must be quantized through the capacity policy before ever shaping a
+# program — a raw observed row count in a fingerprint is one program per
+# data size, exactly the cold-start regression the store exists to avoid.
+_SOURCE_METHODS = {"num_live", "item", "device_get",
+                   "observed", "observed_rows", "selectivity"}
 
 
 def _call_name(node: ast.Call) -> Optional[str]:

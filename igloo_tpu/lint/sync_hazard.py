@@ -76,6 +76,11 @@ CHOKE_POINTS = {
     ("igloo_tpu/exec/codec.py", "_scaled_decimal_ok"):
         "one-time per-process canary: replays the scaled-decimal divide "
         "on device before trusting it (round-5 advisor item).",
+    ("igloo_tpu/parallel/executor.py", "ShardedExecutor._observed_live"):
+        "mesh broadcast decision on OBSERVED rows, not padded capacity: "
+        "first sight of a subtree costs one live-count sync to seed the "
+        "persistent hint (same contract as Executor._adaptive_input); "
+        "later runs are sync-free.",
 }
 
 _SOURCE_PREFIXES = ("jnp.", "jax.lax.", "jax.nn.", "jax.numpy.")

@@ -54,7 +54,7 @@ from igloo_tpu.parallel.shuffle import (
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import stats, tracing
 
 
 def _col_ref(i: int, dtype: T.DataType, out_dict=None) -> Compiled:
@@ -76,9 +76,9 @@ class ShardedExecutor(Executor):
 
     def __init__(self, jit_cache: Optional[dict] = None, use_jit: bool = True,
                  batch_cache=None, speculate: bool = True,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, hints=None):
         super().__init__(jit_cache, use_jit=use_jit, batch_cache=batch_cache,
-                         speculate=speculate)
+                         speculate=speculate, hints=hints)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_dev = int(self.mesh.devices.size)
 
@@ -88,7 +88,7 @@ class ShardedExecutor(Executor):
         tracing.counter("join.speculation_overflow")
         return ShardedExecutor(self._cache, use_jit=self._use_jit,
                                batch_cache=self._batch_cache, speculate=False,
-                               mesh=self.mesh)
+                               mesh=self.mesh, hints=self._hints)
 
     def _exec_scan(self, plan: L.Scan) -> DeviceBatch:
         key = snap = None
@@ -666,6 +666,34 @@ class ShardedExecutor(Executor):
 
     # --- sharded join ---
 
+    def _observed_live(self, batch: DeviceBatch,
+                       plan_node: L.LogicalPlan) -> int:
+        """Observed row count for the broadcast decision: padded CAPACITIES
+        mis-size a compacted small build side (a filtered 5k-row side sitting
+        in a canonical 2^20-lane buffer looks a million rows wide and never
+        broadcasts). Uses the staged tier's persisted num_live hint — same
+        key as Executor._adaptive_input — paying ONE sync on first sight of
+        a subtree; falls back to capacity for unkeyable shapes or with
+        IGLOO_ADAPTIVE=0 (the old behavior, bit for bit)."""
+        from igloo_tpu.exec.hints import adaptive_enabled, plan_fp
+        if not adaptive_enabled():
+            return batch.capacity
+        fp = plan_fp(plan_node)
+        if fp is None:
+            return batch.capacity
+        key = ("slive", fp, batch.capacity)
+        hint = self._staged_hint(key)
+        if hint is None:
+            n = batch.num_live()  # one sync, first sight of this subtree
+            tracing.counter("adaptive.live_sync")
+            self._cache[("nhint", key)] = n
+            if self._hints is not None:
+                self._hints.put(key, n)
+                self._hints.flush()
+            stats.observe_card(fp, n)
+            return n
+        return int(hint)
+
     def _exec_join(self, plan: L.Join) -> DeviceBatch:
         left = self._exec(plan.left)
         right = self._exec(plan.right)
@@ -705,7 +733,8 @@ class ShardedExecutor(Executor):
 
         if jt in (JoinType.INNER, JoinType.LEFT, JoinType.SEMI,
                   JoinType.ANTI) and \
-                should_broadcast(left.capacity, right.capacity, n):
+                should_broadcast(self._observed_live(left, plan.left),
+                                 self._observed_live(right, plan.right), n):
             # broadcast join (skew escape hatch, parallel/shuffle.py rule):
             # replicate the build side, never shuffle the probe side — a hot
             # probe key stays spread across the devices that hold it. Build-

@@ -45,8 +45,13 @@ at all:
   at plan time) -> gathered exact, never a re-shuffle loop.
 
 (Splitting the hot key across devices and re-merging is the other textbook
-fix; it needs a per-key histogram sync, which costs more than the broadcast
-on every workload we generate, so it is documented here and not built.)
+fix; ON THE MESH it needs a per-key histogram sync, which costs more than
+the broadcast on every workload we generate, so it is not built here. The
+FRAGMENT tier builds exactly that fix — hot-key salting, cluster/exchange.py
+— because there the histogram is free: the fragment store already records
+per-bucket row counts, and the coordinator feeds them back as a skew sketch
+(docs/adaptive.md). `pathological_share` below is the shared bound both
+tiers call skew "pathological" at.)
 """
 from __future__ import annotations
 
@@ -58,7 +63,24 @@ import jax.numpy as jnp
 from igloo_tpu.exec.batch import round_capacity
 
 
-def default_bucket_cap(local_cap: int, n_dev: int, factor: int = 4) -> int:
+#: skew a speculative exchange tolerates before it becomes pathological: the
+#: same 4x headroom `default_bucket_cap` sizes its buckets with — a bucket
+#: past 4x the uniform share overflows every speculative sizing
+SALT_SKEW_FACTOR = 4
+
+
+def pathological_share(nbuckets: int,
+                       factor: float = SALT_SKEW_FACTOR) -> float:
+    """Max-bucket share above which hash partitioning is PATHOLOGICALLY
+    skewed at this bucket count: the hot bucket exceeds `factor`x its
+    uniform share (the bound the module docstring documents). Capped at 0.75
+    so small bucket counts — where factor x uniform exceeds 1.0 and could
+    never flag — still recognize a dominating bucket."""
+    return min(factor / max(nbuckets, 1), 0.75)
+
+
+def default_bucket_cap(local_cap: int, n_dev: int,
+                       factor: int = SALT_SKEW_FACTOR) -> int:
     """Speculative bucket size: `factor`x the uniform share, capped at the safe
     bound L. factor=4 tolerates 4x hash skew before the overflow re-run."""
     if n_dev <= 1:

@@ -213,16 +213,28 @@ class Exchange(LogicalPlan):
     the reference's never-built FragmentType::Shuffle, fragment.rs:12): the
     worker executes `input`, then hash-partitions the result by the key
     columns (indices into the input schema) into `buckets` bucket slices
-    served via bucketed do_get tickets. Never reaches a local executor."""
+    served via bucketed do_get tickets. Never reaches a local executor.
+
+    Hot-key salting (docs/adaptive.md): when the adaptive skew sketch flags
+    bucket `salt_bucket` as pathologically hot, the exchange grows `salt - 1`
+    extra buckets. The PROBE side spreads its hot-bucket rows round-robin
+    across {salt_bucket} + the extra buckets; the BUILD side keeps its
+    hot-bucket rows in place AND replicates them into every extra bucket, so
+    each salted join fragment still sees every build row that could match."""
     input: LogicalPlan = None  # type: ignore[assignment]
     keys: list[int] = field(default_factory=list)
     buckets: int = 1
+    salt_bucket: Optional[int] = None
+    salt: int = 1                      # salted bucket count S (1 = no salting)
+    salt_role: Optional[str] = None    # "probe" | "build"
 
     def children(self):
         return [self.input]
 
     def node_name(self):
-        return f"Exchange(keys={self.keys}, buckets={self.buckets})"
+        s = (f", salt={self.salt}@{self.salt_bucket}/{self.salt_role}"
+             if self.salt_role else "")
+        return f"Exchange(keys={self.keys}, buckets={self.buckets}{s})"
 
 
 def copy_plan(plan: LogicalPlan) -> LogicalPlan:

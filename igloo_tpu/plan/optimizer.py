@@ -34,6 +34,7 @@ def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
     plan = reorder_cross_joins(plan)
     plan = pushdown_filters(plan)
     plan = semi_join_reduction(plan)
+    plan = reorder_adaptive_joins(plan)
     plan = prune_projections(plan)
     return plan
 
@@ -360,6 +361,249 @@ def _is_identity_prefix(p: L.Project) -> bool:
     drops trailing columns, so lower column indexes pass through unchanged."""
     return all(isinstance(e, E.Column) and e.index == i
                for i, e in enumerate(p.exprs))
+
+
+# --- adaptive join reorder (observed cardinalities) -------------------------------
+
+
+import threading
+
+_adaptive_tls = threading.local()
+
+
+def last_adaptive_decisions() -> list:
+    """Reorder decisions from the most recent optimize() on this thread —
+    the engine appends them to EXPLAIN output and the coordinator merges
+    them into last_metrics["adaptive"] (docs/adaptive.md). Cleared at the
+    start of every reorder pass, so a query that reorders nothing (or runs
+    with IGLOO_ADAPTIVE=0) reports nothing."""
+    return list(getattr(_adaptive_tls, "decisions", ()))
+
+
+def reorder_adaptive_joins(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Reorder commutable INNER equi-join spines greedily by effective build
+    size: smallest relation first, then smallest CONNECTED relation at each
+    step, so the cheapest effective build side sorts/probes first and join
+    intermediates stay narrow (q9's six-table chain, q18's chain above the
+    semi join are the targets).
+
+    Effective size is OBSERVED output cardinality x estimated row width when
+    the AdaptiveStats store (exec/hints.py) holds an observation for the
+    subtree's structural fingerprint — post-filter cardinality bakes the
+    filter's real selectivity in — and `estimated_lane_bytes` of the
+    subtree's scans otherwise. First run: estimates; later runs: observed
+    (one recompile ever, thanks to the canonical shape families of
+    docs/compile_cache.md).
+
+    Only provably commutable spines rewrite: INNER nodes, all keys plain
+    Columns, no residuals. Spines whose greedy order equals written order
+    are returned UNCHANGED (the IGLOO_ADAPTIVE=0 kill switch then reproduces
+    the same plans bit-identically); otherwise a Project on top restores the
+    original column order so everything above is untouched."""
+    from igloo_tpu.exec.hints import adaptive_enabled
+    _adaptive_tls.decisions = []
+    if not adaptive_enabled():
+        return plan
+    return _adaptive_visit(plan)
+
+
+def _adaptive_visit(plan: L.LogicalPlan) -> L.LogicalPlan:
+    if isinstance(plan, L.Join):
+        flat = _flatten_inner_spine(plan)
+        if flat is not None:
+            rels, edges = flat
+            order, source = _spine_order(rels, edges)
+            if order is not None and order != list(range(len(rels))):
+                rels = [_adaptive_visit(r) for r in rels]
+                rebuilt = _rebuild_spine(plan, rels, edges, order)
+                if rebuilt is not None:
+                    from igloo_tpu.utils import tracing
+                    tracing.counter("adaptive.reorder")
+                    tracing.counter("adaptive.reorder_observed"
+                                    if source == "observed"
+                                    else "adaptive.reorder_estimated")
+                    _adaptive_tls.decisions.append({
+                        "strategy": "reorder",
+                        "join_order": list(order),
+                        "adaptive_source": source})
+                    return rebuilt
+    for name in ("input", "left", "right"):
+        ch = getattr(plan, name, None)
+        if isinstance(ch, L.LogicalPlan):
+            setattr(plan, name, _adaptive_visit(ch))
+    if isinstance(plan, L.Union):
+        plan.inputs = [_adaptive_visit(c) for c in plan.inputs]
+    return plan
+
+
+def _flatten_inner_spine(plan: L.Join):
+    """Flatten a left-deep spine of residual-free INNER equi-joins whose keys
+    are all plain Columns -> (rels, edges) with edge endpoints as GLOBAL
+    column indexes over the written-order concat schema; None when the shape
+    doesn't commute or is under 3 relations."""
+    rels: list = []
+    edges: list = []
+
+    def rec(node) -> None:
+        if isinstance(node, L.Join) and node.join_type is JoinType.INNER \
+                and node.left_keys and node.residual is None and \
+                all(type(k) is E.Column
+                    for k in node.left_keys + node.right_keys):
+            rec(node.left)
+            lw = len(node.left.schema)
+            rels.append(node.right)
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                edges.append((lk.index, lw + rk.index))
+            return
+        rels.append(node)
+
+    rec(plan)
+    if len(rels) < 3 or len(plan.schema) != sum(len(r.schema) for r in rels):
+        return None
+    return rels, edges
+
+
+def _est_subtree_lane_bytes(p: L.LogicalPlan) -> Optional[int]:
+    """Estimated decoded device-lane bytes of the scans under `p`; None when
+    any scan is unsized (then written order stands — no guess is better than
+    a wrong one)."""
+    from igloo_tpu.exec.chunked import estimated_lane_bytes
+    total = 0
+    for n in L.walk_plan(p):
+        if isinstance(n, L.Scan):
+            if n.provider is None:
+                return None
+            nb = estimated_lane_bytes(n.provider)
+            if nb is None:
+                return None
+            total += nb
+    return total
+
+
+def _spine_order(rels: list, edges: list):
+    """Greedy smallest-connected-first order over the relation graph, or
+    (None, ...) when any relation is unsized or the graph would force a
+    cross join the written order avoided."""
+    from igloo_tpu.exec.hints import adaptive_store, plan_fp, row_width_bytes
+    store = adaptive_store()
+    sizes: list = []
+    observed = 0
+    for r in rels:
+        fp = plan_fp(r)
+        rows = store.observed_rows(fp) if fp is not None else None
+        if rows is not None:
+            sizes.append(rows * row_width_bytes(r.schema))
+            observed += 1
+        else:
+            est = _est_subtree_lane_bytes(r)
+            if est is None:
+                return None, None
+            sizes.append(est)
+    offsets, off = [], 0
+    for r in rels:
+        offsets.append(off)
+        off += len(r.schema)
+
+    def rel_of(g: int) -> int:
+        for i in range(len(rels) - 1, -1, -1):
+            if g >= offsets[i]:
+                return i
+        return 0
+
+    rel_edges = {(rel_of(a), rel_of(b)) for a, b in edges}
+    order = [min(range(len(rels)), key=lambda i: sizes[i])]
+    remaining = [i for i in range(len(rels)) if i != order[0]]
+    while remaining:
+        conn = [i for i in remaining
+                if any((p, i) in rel_edges or (i, p) in rel_edges
+                       for p in order)]
+        if not conn:
+            return None, None  # disconnected: would introduce a cross join
+        nxt = min(conn, key=lambda i: sizes[i])
+        order.append(nxt)
+        remaining.remove(nxt)
+    return order, ("observed" if observed == len(rels) else
+                   "estimated" if observed == 0 else "mixed")
+
+
+def _rebuild_spine(spine: L.Join, rels: list, edges: list,
+                   order: list) -> Optional[L.LogicalPlan]:
+    """Left-deep INNER chain in `order` + a Project restoring the original
+    column order. Every edge is consumed as a join key the moment its
+    later-placed relation joins the chain; a cyclic edge whose endpoints are
+    already co-resident becomes an equality filter above the chain."""
+    offsets, off = [], 0
+    for r in rels:
+        offsets.append(off)
+        off += len(r.schema)
+
+    def rel_of(g: int) -> int:
+        for i in range(len(rels) - 1, -1, -1):
+            if g >= offsets[i]:
+                return i
+        return 0
+
+    def gfield(g: int) -> T.Field:
+        i = rel_of(g)
+        return rels[i].schema.fields[g - offsets[i]]
+
+    def col(name: str, idx: int, dtype) -> E.Column:
+        c = E.Column(name, index=idx)
+        c.dtype = dtype
+        return c
+
+    placed = {order[0]}
+    chain: L.LogicalPlan = rels[order[0]]
+    pos = {offsets[order[0]] + k: k
+           for k in range(len(rels[order[0]].schema))}
+    consumed = [False] * len(edges)
+    for i in order[1:]:
+        lkeys, rkeys = [], []
+        for ei, (a, b) in enumerate(edges):
+            if consumed[ei]:
+                continue
+            if rel_of(a) in placed and rel_of(b) == i:
+                gl, gr = a, b
+            elif rel_of(b) in placed and rel_of(a) == i:
+                gl, gr = b, a
+            else:
+                continue
+            consumed[ei] = True
+            lf, rf = gfield(gl), gfield(gr)
+            lkeys.append(col(lf.name, pos[gl], lf.dtype))
+            rkeys.append(col(rf.name, gr - offsets[i], rf.dtype))
+        if not lkeys:
+            return None  # pragma: no cover - connectivity guaranteed above
+        j = L.Join(left=chain, right=rels[i], join_type=JoinType.INNER,
+                   left_keys=lkeys, right_keys=rkeys)
+        j.schema = T.Schema(list(chain.schema.fields) +
+                            list(rels[i].schema.fields))
+        base = len(pos)
+        for k in range(len(rels[i].schema)):
+            pos[offsets[i] + k] = base + k
+        placed.add(i)
+        chain = j
+    # restore the ORIGINAL column order (and names) above the new chain
+    orig = spine.schema
+    exprs = []
+    for g in range(off):
+        f = gfield(g)
+        exprs.append(col(f.name, pos[g], f.dtype))
+    proj = L.Project(input=chain, exprs=exprs, names=list(orig.names))
+    proj.schema = orig
+    # cyclic edges with both endpoints placed before consumption cannot
+    # occur (each edge is consumed when its later relation is placed), but
+    # guard anyway: any leftover becomes an equality filter above the
+    # restoring projection, where the original global indexes are valid
+    preds = []
+    for ei, (a, b) in enumerate(edges):
+        if not consumed[ei]:
+            fa, fb = gfield(a), gfield(b)
+            eq = E.Binary(op=E.BinOp.EQ, left=col(fa.name, a, fa.dtype),
+                          right=col(fb.name, b, fb.dtype))
+            eq.dtype = T.BOOL
+            preds.append(eq)
+    return _wrap_filter(proj, preds) if preds else proj
 
 
 # --- constant folding -------------------------------------------------------------

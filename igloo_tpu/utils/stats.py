@@ -88,6 +88,11 @@ class QueryStats:
     # terminal outcome: "ok" | "cancelled" | "deadline_exceeded" | "error"
     # (non-ok values come from the distributed tier's deadline/cancel paths)
     status: str = "ok"
+    # (fingerprint key, observed rows) pairs recorded where a row count was
+    # free or already paid for (host tier, detail-mode syncs, first-sight
+    # adaptive-input syncs); the engine folds them into the process-wide
+    # AdaptiveStats store at query end (exec/hints.py, docs/adaptive.md)
+    observations: list = field(default_factory=list)
 
     # --- programmatic access ------------------------------------------------
 
@@ -268,6 +273,18 @@ def bump_attr(key: str, delta: int = 1) -> None:
     if node is not None:
         with _totals_lock:
             node.attrs[key] = node.attrs.get(key, 0) + delta
+
+
+def observe_card(key, rows: int) -> None:
+    """Record one observed subtree cardinality for the adaptive feedback
+    loop. Callers only invoke this where the count is already in hand (free
+    host/Arrow shapes, a sync another feature paid for) — the hook itself
+    must never add device syncs."""
+    qs = getattr(_tls, "qstats", None)
+    if qs is None:
+        return
+    with _totals_lock:
+        qs.observations.append((key, int(rows)))
 
 
 def record_compile(seconds: float) -> None:

@@ -24,6 +24,9 @@ python scripts/chaos_smoke.py
 echo "== persistent compile-cache smoke (two-process cold/warm) =="
 python scripts/compile_cache_smoke.py
 
+echo "== adaptive smoke (skew sketch -> salted exchange beats unsalted) =="
+python scripts/adaptive_smoke.py
+
 echo "== pytest (fast tier, virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q -m "not slow"
 

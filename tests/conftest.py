@@ -35,6 +35,24 @@ import igloo_tpu.engine  # noqa: E402
 
 igloo_tpu.engine.DEFAULT_MESH = None
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_adaptive_store():
+    """The AdaptiveStats store (exec/hints.py) is process-global on purpose —
+    the coordinator, planner, and engines share one feedback loop — but
+    across TESTS that persistence would make plan shapes depend on which
+    tests ran before (a shuffle-shape assertion flips to broadcast once an
+    earlier test observed the same join side). Each test starts with a fresh
+    in-memory store; tests of the feedback loop exercise persistence by
+    pointing IGLOO_ADAPTIVE_STATS at their own tmp file."""
+    from igloo_tpu.exec import hints
+    hints.reset_adaptive_store()
+    yield
+    hints.reset_adaptive_store()
+
+
 # NOTE (round 4): a session-shared jit compile cache was tried here to cut
 # CPU compile time and REVERTED: keeping every compiled XLA:CPU executable
 # alive for the whole session reproducibly segfaulted the process in

@@ -28,3 +28,24 @@ class Ex:
     def suppressed(self, batch, build):
         # justified one-off: documented rationale would go here
         return self._jitted("x", ("x", batch.num_live()), build)  # lint: allow(jit-key)
+
+
+class AdaptiveEx:
+    """Adaptive-stats accessors are taint sources: observed cardinalities
+    must never reach a _jitted fingerprint unquantized."""
+
+    def _jitted(self, kind, fp, build):
+        return build()
+
+    def observed_rows_in_key(self, store, fp_key, build):
+        rows = store.observed_rows(fp_key)
+        return self._jitted("probe", ("probe", rows), build)  # BAD
+
+    def observed_record_in_key(self, store, fp_key, build):
+        rec = store.observed(fp_key)
+        cap = max(rec["rows"], 1) * 2
+        return self._jitted("agg", ("agg", cap), build)  # BAD
+
+    def selectivity_in_key(self, store, fp_key, build):
+        sel = store.selectivity(fp_key)
+        return self._jitted("join", ("join", sel), build)  # BAD

@@ -42,3 +42,22 @@ class Ex:
     def cast_of_sanitized(self, batch, build):
         want = int(round_capacity(batch.num_live()))
         return self._jitted("compact", ("compact", want), build)
+
+
+class AdaptiveEx:
+    """Adaptive-stats values are fine once quantized through the capacity
+    policy, or when they only steer CONTROL FLOW (plan/route choices)."""
+
+    def _jitted(self, kind, fp, build):
+        return build()
+
+    def quantized_observation(self, store, fp_key, build):
+        rows = store.observed_rows(fp_key)
+        want = round_capacity(max(rows or 1, 1))
+        return self._jitted("compact", ("compact", want), build)
+
+    def observation_routes_only(self, store, fp_key, build_a, build_b):
+        rows = store.observed_rows(fp_key)
+        if rows is not None and rows < 1024:
+            return self._jitted("small", ("small", 1024), build_a)
+        return self._jitted("big", ("big", 4096), build_b)
