@@ -14,15 +14,17 @@ dispatching fragments and releases worker results at the deadline), and
 from __future__ import annotations
 
 import json
+import time
 from typing import Optional
 
 import pyarrow as pa
 import pyarrow.flight as flight
 
-from igloo_tpu.cluster import rpc
+from igloo_tpu.cluster import rpc, serving
 from igloo_tpu.cluster.rpc import call_options as _call_options
 from igloo_tpu.cluster.rpc import normalize as _normalize
 from igloo_tpu.errors import IglooError
+from igloo_tpu.utils import tracing
 
 
 class DistributedClient:
@@ -50,27 +52,74 @@ class DistributedClient:
     # --- queries ---
 
     def execute(self, sql: str, deadline_s: Optional[float] = None,
-                qid: Optional[str] = None) -> pa.Table:
+                qid: Optional[str] = None, priority: Optional[int] = None,
+                session: Optional[str] = None,
+                busy_wait_s: Optional[float] = None) -> pa.Table:
         """One round trip: the ticket IS the SQL (do_get executes once).
         `deadline_s` bounds the query server-side (and this call, slightly
         padded so the coordinator's deadline fires first and reports
-        properly); `qid` names it for `cancel`."""
+        properly); `qid` names it for `cancel`; `priority` (0 = interactive
+        ... lower tiers) and `session` feed the coordinator's admission
+        controller (docs/serving.md).
+
+        Retry model: a SHED query (the coordinator's admission queue was
+        full — `IGLOO_BUSY` marker) is retried with backoff honoring the
+        server's retry-after hint until `busy_wait_s` (default 60 s, or the
+        query deadline when one is set) — overload means bounded extra
+        latency, not a failure. Other RETRYABLE transport failures
+        (unavailable peer, timeout) use the policy's normal retry budget;
+        fatal errors (the query itself failed) surface immediately.
+        Retrying from scratch is safe: results materialize via read_all(),
+        so no partial batches were consumed."""
         ticket = sql
-        if deadline_s is not None or qid is not None:
-            body = {"sql": sql}
+        if deadline_s is not None or qid is not None \
+                or priority is not None or session is not None:
+            body: dict = {"sql": sql}
             if deadline_s is not None:
                 body["deadline_s"] = deadline_s
             if qid is not None:
                 body["qid"] = qid
+            if priority is not None:
+                body["priority"] = priority
+            if session is not None:
+                body["session"] = session
             ticket = json.dumps(body)
         timeout = self._policy.stream_timeout_s if deadline_s is None \
             else deadline_s + min(5.0, self._policy.connect_timeout_s)
-        try:
-            reader = self._client.do_get(flight.Ticket(ticket.encode()),
-                                         _call_options(timeout_s=timeout))
-            return reader.read_all()
-        except flight.FlightError as ex:
-            raise IglooError(_strip_flight(str(ex))) from None
+        if busy_wait_s is None:
+            busy_wait_s = deadline_s if deadline_s is not None else 60.0
+        busy_deadline = time.time() + busy_wait_s
+        # SEPARATE budgets: sheds are bounded by busy_deadline only and must
+        # not consume the transport retry budget — a client shed twice under
+        # load still deserves its full policy budget for an unrelated
+        # transient transport failure afterwards
+        busy_attempt = 0
+        attempt = 0
+        while True:
+            try:
+                reader = self._client.do_get(
+                    flight.Ticket(ticket.encode()),
+                    _call_options(timeout_s=timeout))
+                return reader.read_all()
+            except flight.FlightError as ex:
+                msg = str(ex)
+                if serving.BUSY_MARKER in msg:
+                    # load shed: bounded-latency retry, not a failure
+                    hint = serving.parse_retry_after(msg)
+                    delay = hint if hint is not None \
+                        else self._policy.backoff_s(busy_attempt + 1)
+                    if time.time() + delay >= busy_deadline:
+                        raise IglooError(_strip_flight(msg)) from None
+                    busy_attempt += 1
+                    tracing.counter("client.busy_retries")
+                    time.sleep(delay)
+                    continue
+                if rpc.retryable(ex) and attempt < self._policy.retries:
+                    attempt += 1
+                    tracing.counter("rpc.retries")
+                    time.sleep(self._policy.backoff_s(attempt))
+                    continue
+                raise IglooError(_strip_flight(msg)) from None
 
     sql = execute
 

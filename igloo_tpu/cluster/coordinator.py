@@ -36,7 +36,7 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.flight as flight
 
-from igloo_tpu.cluster import faults, rpc, serde
+from igloo_tpu.cluster import faults, rpc, serde, serving
 from igloo_tpu.cluster.fragment import DistributedPlanner, QueryFragment
 from igloo_tpu.cluster.rpc import flight_action
 from igloo_tpu.engine import QueryEngine
@@ -58,6 +58,44 @@ QUERY_DEADLINE_ENV = "IGLOO_QUERY_DEADLINE_S"
 #: that evicted the whole fleet should stall the query briefly, not fail it
 #: (bounded by the query deadline when one is set)
 RECOVER_WAIT_S = 5.0
+
+#: front-door result cache for the distributed path (docs/serving.md):
+#: repeated dashboard-shaped queries short-circuit admission entirely. "0"
+#: disables it — the A/B the test suite and the adaptive/chaos smokes pin
+#: (a cached query skips execution, so assertions about what execution DID
+#: would otherwise flip on repetition).
+RESULT_CACHE_ENV = "IGLOO_SERVING_RESULT_CACHE"
+
+#: distributed results larger than this are not teed into the result cache
+#: while being relayed (the coordinator would otherwise materialize what
+#: streaming exists to avoid)
+RESULT_CACHE_MAX_BYTES = 64 << 20
+
+
+def _is_oom(ex: BaseException) -> bool:
+    """An out-of-device-memory failure the degradation ladder can absorb:
+    a Python MemoryError, XLA's RESOURCE_EXHAUSTED, or either surfacing in
+    a worker-reported fragment failure's message."""
+    if isinstance(ex, MemoryError):
+        return True
+    msg = str(ex)
+    return ("RESOURCE_EXHAUSTED" in msg or "MemoryError" in msg
+            or "Out of memory" in msg or "out of memory" in msg)
+
+
+def _released_stream(gen, permit):
+    """Wrap a result stream so its serving permit releases when the stream
+    finishes, errors, or is abandoned unconsumed (weakref finalizer — an
+    unstarted generator's close() never enters its finally block).
+    `Permit.release` is idempotent, so double-firing is safe."""
+    def g():
+        try:
+            yield from gen
+        finally:
+            permit.release()
+    out = g()
+    weakref.finalize(out, permit.release)
+    return out
 
 
 @dataclass
@@ -202,16 +240,19 @@ class DistributedExecutor:
     def execute(self, fragments: list[QueryFragment],
                 deadline_s: Optional[float] = None,
                 qid: Optional[str] = None, sql: str = "",
-                adaptive_info: Optional[list] = None) -> pa.Table:
+                adaptive_info: Optional[list] = None,
+                extra_metrics: Optional[dict] = None) -> pa.Table:
         schema, gen = self.execute_stream(fragments, deadline_s=deadline_s,
                                           qid=qid, sql=sql,
-                                          adaptive_info=adaptive_info)
+                                          adaptive_info=adaptive_info,
+                                          extra_metrics=extra_metrics)
         return pa.Table.from_batches(list(gen), schema=schema)
 
     def execute_stream(self, fragments: list[QueryFragment],
                        deadline_s: Optional[float] = None,
                        qid: Optional[str] = None, sql: str = "",
-                       adaptive_info: Optional[list] = None
+                       adaptive_info: Optional[list] = None,
+                       extra_metrics: Optional[dict] = None
                        ) -> tuple[pa.Schema, object]:
         """Run the fragment waves, then return (schema, batch generator)
         streaming the root result from its worker — the coordinator never
@@ -251,6 +292,10 @@ class DistributedExecutor:
                          # evicted addr too — its handler may still be
                          # running and needs the tombstone
                          "_addrs": set()}
+        if extra_metrics:
+            # serving-path facts (queue_wait_s / priority / demoted) ride
+            # beside the execution metrics into last_metrics + query_log
+            metrics.update(extra_metrics)
         shuffle_buckets = {f.bucket for f in fragments
                           if f.bucket is not None}
         metrics["shuffle_buckets"] = len(shuffle_buckets)
@@ -272,10 +317,14 @@ class DistributedExecutor:
                             for f in ready}
                     dead: set[str] = set()
                     lost_deps: set[str] = set()
+                    busy: list = []
                     for fut in cf.as_completed(futs):
                         f = futs[fut]
                         try:
                             fut.result()
+                        except _WorkerBusy as ex:
+                            busy.append((f.id, ex.addr))
+                            continue
                         except _WorkerDied as ex:
                             dead.add(ex.addr)
                             continue
@@ -284,6 +333,18 @@ class DistributedExecutor:
                             continue
                         completed[f.id] = f.worker
                         pending.discard(f.id)
+                    if busy:
+                        # saturated-but-ALIVE workers (WORKER_BUSY, all
+                        # execution slots occupied): requeue elsewhere
+                        # WITHOUT eviction — backpressure is not death, and
+                        # the target's slot wait paces the retry loop
+                        live_now = self._live_addrs()
+                        for i, (fid, addr) in enumerate(busy):
+                            others = [a for a in live_now if a != addr]
+                            if others:
+                                frags[fid].worker = others[i % len(others)]
+                            tracing.counter(
+                                "coordinator.fragments_requeued_busy")
                     for dep_id in lost_deps:
                         # the holder of this dep result is unreachable from a
                         # peer: treat it as dead and re-run the dep
@@ -439,7 +500,10 @@ class DistributedExecutor:
         self._accumulate(pub)
         stats.log_query(sql, elapsed_s=pub["execution_time_s"],
                         tier="distributed", rows=pub.get("total_rows"),
-                        status=status, started_at=t_start)
+                        status=status, started_at=t_start,
+                        queue_wait_s=pub.get("queue_wait_s", 0.0),
+                        priority=pub.get("priority", 1),
+                        demoted=pub.get("demoted", 0))
 
     def _record_adaptive(self, frag_infos: list) -> None:
         """Fold a finished query's per-fragment reports into the process-wide
@@ -550,6 +614,10 @@ class DistributedExecutor:
                 raise _DepLost(dep_id)
             raise  # execution error on a live worker: surface it
         except Exception as ex:
+            if "WORKER_BUSY" in str(ex):
+                # all execution slots occupied on a HEALTHY worker: requeue
+                # the fragment elsewhere, never evict (docs/serving.md)
+                raise _WorkerBusy(f.worker)
             # only RETRYABLE failures are a dead-worker signal:
             # FlightTimedOutError (the hung worker — accepted TCP, never
             # answered), FlightUnavailableError, connection errors. Anything
@@ -675,6 +743,14 @@ class _WorkerDied(Exception):
         self.addr = addr
 
 
+class _WorkerBusy(Exception):
+    """Dispatch refused with the WORKER_BUSY marker: every execution slot
+    on a live worker is occupied. Requeue the fragment, never evict."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+
+
 class _DepLost(Exception):
     def __init__(self, frag_id: str):
         self.frag_id = frag_id
@@ -708,6 +784,10 @@ class CoordinatorServer(flight.FlightServerBase):
         self.engine = QueryEngine(use_jit=use_jit)
         self.membership = Membership(worker_timeout_s)
         self.executor = DistributedExecutor(self.membership)
+        # multi-tenant front door (docs/serving.md): bounded per-priority
+        # admission, weighted fair dequeue, per-session caps, HBM-gated
+        # concurrency; IGLOO_SERVING_QUEUE=0 serializes one query at a time
+        self.admission = serving.AdmissionController()
         self._table_specs: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -751,23 +831,78 @@ class CoordinatorServer(flight.FlightServerBase):
 
     def execute_sql(self, sql: str, stream: bool = False,
                     deadline_s: Optional[float] = None,
-                    qid: Optional[str] = None):
+                    qid: Optional[str] = None, priority: int = 1,
+                    session: str = ""):
         """-> pa.Table, or — for `stream=True` on the distributed path —
         (pa.Schema, record-batch generator) so do_get can relay the root
         worker's stream batch-wise instead of materializing it here.
         `deadline_s`/`qid` bound + name the DISTRIBUTED execution (deadline,
-        cancel_query); the local fallback paths run synchronously in-process
-        and are not cancellable mid-flight."""
+        cancel_query); the local fallback paths honor the deadline at their
+        checkpoints (before planning, between plan and execute) but are not
+        cancellable mid-flight. `priority`/`session` feed the admission
+        controller (docs/serving.md): past the queue bound or the session's
+        in-flight cap the query is SHED with a retryable serving.ServerBusy
+        instead of executing."""
+        t_start = time.time()
+        deadline = t_start + deadline_s if deadline_s is not None else None
+        self._check_local_deadline(deadline, sql, t_start, priority,
+                                   planned=False)
+        try:
+            plan = self.engine.plan(sql)
+        except IglooError:
+            # non-SELECT statements (SHOW/DESCRIBE/CTAS/...) run locally,
+            # un-admitted: metadata ops must work even under full overload
+            return self.engine.execute(sql)
+        # plan+snapshot-keyed result cache: a repeated dashboard-shaped
+        # query short-circuits admission (and all execution) entirely
+        rkey = self._result_cache_key(plan)
+        if rkey is not None:
+            hit = self.engine.result_cache.get(rkey)
+            if hit is not None:
+                return self._serve_cached(hit, sql, stream, t_start,
+                                          priority, qid)
+        try:
+            permit = self.admission.submit(
+                priority=priority, session=session,
+                predicted_hbm_bytes=serving.predict_hbm_bytes(plan),
+                deadline=deadline)
+        except serving.ServerBusy:
+            stats.log_query(sql, elapsed_s=time.time() - t_start,
+                            tier="serving", status="shed",
+                            started_at=t_start, priority=priority)
+            raise
+        try:
+            out = self._execute_admitted(plan, sql, stream, deadline,
+                                         deadline_s, qid, permit, rkey,
+                                         t_start)
+        except BaseException:
+            permit.release()
+            raise
+        if stream and isinstance(out, tuple):
+            # the permit rides the stream: concurrency and the HBM
+            # reservation are held until the relay finishes (worker-held
+            # results live exactly that long)
+            schema, gen = out
+            return schema, _released_stream(gen, permit)
+        permit.release()
+        return out
+
+    def _execute_admitted(self, plan, sql: str, stream: bool,
+                          deadline: Optional[float],
+                          deadline_s: Optional[float], qid: Optional[str],
+                          permit: "serving.Permit", rkey, t_start: float):
+        """The admitted execution body: distributed when possible, local
+        fallback otherwise, with the degradation ladder absorbing OOM."""
+        if permit.demote:
+            # predicted past the WHOLE HBM budget: no concurrency setting
+            # makes it fit, so run it straight through the budget-
+            # constrained ladder instead of letting it crash first
+            return self._run_demoted(sql, stream, deadline, t_start, permit)
         live = self.membership.live()
         if not live:
             # a coordinator with no workers is still a working single-node
             # engine (the reference coordinator main is exactly that)
-            return self.engine.execute(sql)
-        try:
-            plan = self.engine.plan(sql)
-        except IglooError:
-            # non-SELECT statements (SHOW/DESCRIBE/CTAS/...) run locally
-            return self.engine.execute(sql)
+            return self._run_local(sql, stream, deadline, t_start, permit)
         synced = []
         for w in live:
             try:
@@ -778,11 +913,9 @@ class CoordinatorServer(flight.FlightServerBase):
                 # query until the sweeper notices
                 self.membership.evict(w.worker_id)
         live = synced
-        if not live:
-            return self.engine.execute(sql)
-        # only distribute plans whose base tables every worker can resolve
-        if not self._distributable(plan):
-            return self.engine.execute(sql)
+        if not live or not self._distributable(plan):
+            # only distribute plans whose base tables every worker resolves
+            return self._run_local(sql, stream, deadline, t_start, permit)
         planner = DistributedPlanner([w.addr for w in live])
         frags = planner.plan(plan)
         tracing.counter("coordinator.distributed_queries")
@@ -790,13 +923,155 @@ class CoordinatorServer(flight.FlightServerBase):
         # the fragment-tier broadcast/salt records (docs/adaptive.md)
         from igloo_tpu.plan.optimizer import last_adaptive_decisions
         adaptive_info = last_adaptive_decisions() + planner.adaptive_info
+        extra = {"queue_wait_s": round(permit.wait_s, 6),
+                 "priority": permit.priority, "demoted": 0}
+        try:
+            if stream:
+                schema, gen = self.executor.execute_stream(
+                    frags, deadline_s=deadline_s, qid=qid, sql=sql,
+                    adaptive_info=adaptive_info, extra_metrics=extra)
+                return schema, self._caching_stream(schema, gen, rkey)
+            table = self.executor.execute(frags, deadline_s=deadline_s,
+                                          qid=qid, sql=sql,
+                                          adaptive_info=adaptive_info,
+                                          extra_metrics=extra)
+        except Exception as ex:
+            if not _is_oom(ex):
+                raise
+            # a worker (or the relay) ran out of device memory: demote the
+            # query down the local ladder instead of failing it
+            return self._run_demoted(sql, stream, deadline, t_start, permit)
+        self._result_cache_put(rkey, table)
+        return table
+
+    # --- serving helpers (docs/serving.md) ---
+
+    def _check_local_deadline(self, deadline: Optional[float], sql: str,
+                              t_start: float, priority: int,
+                              planned: bool = True) -> None:
+        """`deadline_s` honored on the LOCAL fallback paths too (the
+        distributed executor has its own checks): before planning and
+        between plan and execute, surfacing `query.deadline_exceeded` and a
+        query-log row exactly like the distributed accounting."""
+        if deadline is None or time.time() < deadline:
+            return
+        tracing.counter("query.deadline_exceeded")
+        stats.log_query(sql, elapsed_s=time.time() - t_start, tier="local",
+                        status="deadline_exceeded", started_at=t_start,
+                        priority=priority)
+        where = "execution" if planned else "planning"
+        raise DeadlineExceededError(
+            f"query exceeded its deadline before local {where}")
+
+    def _run_local(self, sql: str, stream: bool, deadline: Optional[float],
+                   t_start: float, permit: "serving.Permit"):
+        """Local fallback execution under the serving context, with the
+        OOM->demote ladder."""
+        self._check_local_deadline(deadline, sql, t_start, permit.priority)
+        with stats.serving_context(queue_wait_s=permit.wait_s,
+                                   priority=permit.priority):
+            try:
+                out = self.engine.execute(sql)
+            except Exception as ex:
+                if not _is_oom(ex):
+                    raise
+                out = self._demote_ladder(sql, deadline, t_start,
+                                          permit.priority, level=1)
+        return (out.schema, iter(out.to_batches())) if stream else out
+
+    def _run_demoted(self, sql: str, stream: bool,
+                     deadline: Optional[float], t_start: float,
+                     permit: "serving.Permit"):
+        """Entry for queries pre-flagged by the HBM gate: straight onto the
+        ladder's first rung."""
+        with stats.serving_context(queue_wait_s=permit.wait_s,
+                                   priority=permit.priority):
+            out = self._demote_ladder(sql, deadline, t_start,
+                                      permit.priority, level=1)
+        return (out.schema, iter(out.to_batches())) if stream else out
+
+    def _demote_ladder(self, sql: str, deadline: Optional[float],
+                       t_start: float, priority: int, level: int):
+        """The graceful-degradation ladder: rung 1 re-runs locally with a
+        chunk budget constrained to the serving HBM budget (forcing the
+        chunked/GRACE out-of-core tiers); rung 2 forces the numpy host
+        tier. Each rung bumps `serving.demoted` + the query-log `demoted`
+        column; an OOM on the last rung surfaces."""
+        self._check_local_deadline(deadline, sql, t_start, priority)
+        tracing.counter("serving.demoted")
+        stats.mark_demoted()
+        budget = self._demote_budget()
+        if level <= 1:
+            try:
+                with self.engine.demoted(budget_bytes=budget):
+                    return self.engine.execute(sql)
+            except Exception as ex:
+                if not _is_oom(ex):
+                    raise
+                return self._demote_ladder(sql, deadline, t_start, priority,
+                                           level=2)
+        with self.engine.demoted(budget_bytes=budget, force_host=True):
+            return self.engine.execute(sql)
+
+    def _demote_budget(self) -> int:
+        """Chunk budget for demoted execution: the serving HBM budget when
+        one is configured (that IS the memory the query must fit), else a
+        quarter of the engine's normal budget; floored so partition counts
+        stay sane."""
+        b = self.admission.hbm_budget_bytes or \
+            self.engine.chunk_budget_bytes // 4
+        return max(int(b), 1 << 20)
+
+    def _result_cache_key(self, plan):
+        if os.environ.get(RESULT_CACHE_ENV, "1") == "0":
+            return None
+        from igloo_tpu.exec.result_cache import plan_cache_key
+        return plan_cache_key(plan)
+
+    def _result_cache_put(self, rkey, table: pa.Table) -> None:
+        if rkey is not None and table.nbytes <= RESULT_CACHE_MAX_BYTES:
+            self.engine.result_cache.put(rkey, table)
+
+    def _serve_cached(self, hit: pa.Table, sql: str, stream: bool,
+                      t_start: float, priority: int, qid: Optional[str]):
+        """A front-door result-cache hit: no admission, no execution —
+        publish attributable metrics (`result_cache_hit` in last_metrics,
+        a tier=result_cache query-log row) and serve the cached table."""
+        elapsed = time.time() - t_start
+        self.executor.last_metrics = {
+            "qid": qid or "", "result_cache_hit": True, "status": "ok",
+            "rows": hit.num_rows, "fragments": [], "recoveries": 0,
+            "execution_time_s": round(elapsed, 6)}
+        stats.log_query(sql, elapsed_s=elapsed, tier="result_cache",
+                        rows=hit.num_rows, started_at=t_start,
+                        priority=priority)
         if stream:
-            return self.executor.execute_stream(
-                frags, deadline_s=deadline_s, qid=qid, sql=sql,
-                adaptive_info=adaptive_info)
-        return self.executor.execute(frags, deadline_s=deadline_s, qid=qid,
-                                     sql=sql,
-                                     adaptive_info=adaptive_info)
+            return hit.schema, iter(hit.to_batches())
+        return hit
+
+    def _caching_stream(self, schema: pa.Schema, gen, rkey):
+        """Relay a distributed result stream while teeing batches into the
+        result cache — giving up silently once the result outgrows the
+        cacheable bound (materializing huge results here would defeat the
+        streaming design)."""
+        if rkey is None:
+            return gen
+
+        def teed():
+            kept: list = []
+            nbytes = 0
+            for batch in gen:
+                if kept is not None:
+                    nbytes += batch.nbytes
+                    if nbytes > RESULT_CACHE_MAX_BYTES:
+                        kept = None
+                    else:
+                        kept.append(batch)
+                yield batch
+            if kept is not None:
+                self._result_cache_put(
+                    rkey, pa.Table.from_batches(kept, schema=schema))
+        return teed()
 
     def _distributable(self, plan) -> bool:
         from igloo_tpu.plan.logical import Scan, walk_plan
@@ -876,6 +1151,9 @@ class CoordinatorServer(flight.FlightServerBase):
             }).encode()]
         if action.type == "last_metrics":
             return [json.dumps(self.executor.last_metrics).encode()]
+        if action.type == "serving_status":
+            # admission queue / slot / HBM-reservation snapshot
+            return [json.dumps(self.admission.snapshot()).encode()]
         if action.type == "metrics":
             # coordinator process registry + worker-aggregated fragment
             # stats, Prometheus text (raw bytes — rpc.flight_action_raw)
@@ -907,6 +1185,8 @@ class CoordinatorServer(flight.FlightServerBase):
                 ("register_table", "register a table from a provider spec"),
                 ("cluster_status", "membership + catalog snapshot"),
                 ("last_metrics", "per-fragment metrics of the last query"),
+                ("serving_status",
+                 "admission queue / concurrency / HBM-reservation snapshot"),
                 ("metrics", "process + worker-aggregated fragment metrics, "
                             "Prometheus text format"),
                 ("ping", "liveness"),
@@ -930,8 +1210,10 @@ class CoordinatorServer(flight.FlightServerBase):
         faults.inject("coordinator.do_get")
         raw = ticket.ticket.decode()
         sql, deadline_s, qid = raw, None, None
+        priority, session = 1, ""
         if raw.lstrip().startswith("{"):
-            # extended ticket: {"sql": ..., "deadline_s": ..., "qid": ...}
+            # extended ticket: {"sql": ..., "deadline_s": ..., "qid": ...,
+            # "priority": ..., "session": ...}
             # (SQL cannot start with "{", so plain-SQL tickets keep working)
             try:
                 d = json.loads(raw)
@@ -947,11 +1229,18 @@ class CoordinatorServer(flight.FlightServerBase):
                 qid = d.get("qid")
                 if qid is not None:
                     qid = str(qid)
+                priority = int(d.get("priority", 1))
+                session = str(d.get("session", ""))
             except (ValueError, KeyError, TypeError):
                 raise flight.FlightServerError(f"bad query ticket: {raw!r}")
         try:
             out = self.execute_sql(sql, stream=True, deadline_s=deadline_s,
-                                   qid=qid)
+                                   qid=qid, priority=priority,
+                                   session=session)
+        except serving.ServerBusy as ex:
+            # retryable by the client's RpcPolicy classification; carries
+            # the retry-after hint in the message (docs/serving.md)
+            raise ex.as_flight_error()
         except IglooError as ex:
             raise flight.FlightServerError(str(ex))
         if isinstance(out, tuple):
@@ -980,6 +1269,8 @@ class CoordinatorServer(flight.FlightServerBase):
             sql = descriptor.command.decode()
             try:
                 table = self.execute_sql(sql)
+            except serving.ServerBusy as ex:
+                raise ex.as_flight_error()
             except IglooError as ex:
                 raise flight.FlightServerError(str(ex))
             writer.begin(table.schema)
@@ -1081,6 +1372,15 @@ def main(argv=None) -> int:
             # a configured 0 means explicitly unbounded
             server.executor.default_deadline_s = \
                 cfg.rpc.query_deadline_s or None
+        # [serving] section: explicit values flow through the controller's
+        # constructor, where IGLOO_SERVING_* env still wins per-field
+        sv = cfg.serving
+        server.admission = serving.AdmissionController(
+            queue_depth=sv.queue_depth,
+            max_concurrency=sv.max_concurrency,
+            session_inflight=sv.session_inflight,
+            hbm_budget_bytes=sv.hbm_budget_bytes,
+            weights=sv.weights)
         for t in cfg.tables:
             server.register_table(t.name, make_provider(t))
     print(f"igloo-coordinator serving on grpc+tcp://{args.host}:"

@@ -5,8 +5,10 @@ query deadlines) is only trustworthy if its paths actually run, and real
 clusters fail too rarely — and too irreproducibly — to exercise them. This
 module injects failures at named points wrapped around every server handler
 (``worker.do_action.<type>``, ``worker.do_get``, ``coordinator.do_action.
-<type>``, ...) and around the client-side RPC policy (``client.action.
-<name>``, ``client.do_get``), driven by a spec:
+<type>``, ...), around the client-side RPC policy (``client.action.
+<name>``, ``client.do_get``), and inside the serving front door
+(``serving.admit`` on every submission — an injected error counts as a
+shed — and ``serving.dequeue`` on every admission grant), driven by a spec:
 
     IGLOO_FAULTS="<point-glob>:<mode>:<prob>[:<count>][,<rule>...]"
 

@@ -20,10 +20,17 @@ in both directions.
 
 Transport is Arrow Flight end-to-end (one stack for control actions and data
 streams) instead of the reference's parallel tonic-gRPC + Flight pair.
+
+Flight serves every RPC on its own thread; `execute_fragment` actions are
+additionally bounded by a slot semaphore (IGLOO_WORKER_SLOTS, default a
+small multiple of the local device count) so concurrent fragment executions
+queue instead of racing the device into OOM — the worker-side half of the
+serving story (docs/serving.md). `worker.slots_busy` gauges the occupancy.
 """
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import uuid
@@ -49,6 +56,22 @@ from igloo_tpu.utils import tracing
 # must be read and written under the server lock (the fragment store has its
 # own internal lock, see cluster/exchange.py)
 _GUARDED_BY = {"_lock": ("_mesh", "_mesh_setting")}
+
+
+#: worker-side fragment-execution slot bound: Flight runs every RPC on its
+#: own thread, so without this two concurrent execute_fragment actions race
+#: each other into device OOM. Default = a small multiple of the local
+#: device count (fragments on one device mostly serialize on it anyway;
+#: a little oversubscription overlaps host-side decode with device work).
+WORKER_SLOTS_ENV = "IGLOO_WORKER_SLOTS"
+
+
+def _default_slots() -> int:
+    try:
+        import jax
+        return max(2, 2 * jax.local_device_count())
+    except Exception:
+        return 2
 
 
 def _dep_key(frag_id: str, bucket) -> str:
@@ -80,7 +103,8 @@ class WorkerServer(flight.FlightServerBase):
 
     def __init__(self, location: str, worker_id: Optional[str] = None,
                  use_jit: bool = True, mesh: object = "default",
-                 store_budget_bytes: Optional[int] = None, **kw):
+                 store_budget_bytes: Optional[int] = None,
+                 slots: Optional[int] = None, **kw):
         mw = rpc.server_middleware()
         if mw is not None:
             kw.setdefault("middleware", mw)
@@ -106,6 +130,16 @@ class WorkerServer(flight.FlightServerBase):
         self._mesh = None
         from igloo_tpu.exec.cache import BatchCache
         self._batch_cache = BatchCache(1 << 30)
+        # fragment-execution slot bound (env > constructor > device-derived
+        # default): concurrent execute_fragment RPCs queue on the semaphore
+        # instead of racing the device into OOM (docs/serving.md)
+        env = os.environ.get(WORKER_SLOTS_ENV)
+        if env:
+            slots = int(env)
+        if slots is None:
+            slots = _default_slots()
+        self.slots = max(1, slots)
+        self._slots = threading.BoundedSemaphore(self.slots)
 
     # --- execution ---
 
@@ -242,10 +276,29 @@ class WorkerServer(flight.FlightServerBase):
         body = action.body.to_pybytes() if action.body is not None else b""
         req = json.loads(body) if body else {}
         if action.type == "execute_fragment":
+            # slot bound: a saturated worker must answer with the WORKER_BUSY
+            # marker BEFORE the coordinator's dispatch RPC deadline concludes
+            # it is hung (call_timeout_s=120 under a query deadline, the
+            # stream bound without one) — so the wait is capped at half a
+            # short bound, never the fragment's full deadline. The
+            # coordinator REQUEUES a busy fragment without evicting us.
+            wait_s = min(float(req.get("timeout_s") or 60.0), 60.0) / 2
+            t0 = time.perf_counter()
+            if not self._slots.acquire(timeout=max(wait_s, 0.001)):
+                tracing.counter("worker.slot_timeouts")
+                raise flight.FlightUnavailableError(
+                    f"WORKER_BUSY worker {self.worker_id}: all {self.slots} "
+                    "execution slots busy")
+            tracing.gauge_add("worker.slots_busy", 1)
+            tracing.histogram("worker.slot_wait_s",
+                              time.perf_counter() - t0)
             try:
                 out = self._execute_fragment(req)
             except IglooError as ex:
                 raise flight.FlightServerError(f"fragment failed: {ex}")
+            finally:
+                tracing.gauge_add("worker.slots_busy", -1)
+                self._slots.release()
             return [json.dumps(out).encode()]
         if action.type == "register_table":
             provider = serde.provider_from_spec(req["spec"])
@@ -262,7 +315,8 @@ class WorkerServer(flight.FlightServerBase):
             own = [i for i in self._store.ids() if not i.startswith("__dep_")]
             return [json.dumps({"worker": self.worker_id,
                                 "tables": sorted(self._catalog.names()),
-                                "fragments": len(own)}).encode()]
+                                "fragments": len(own),
+                                "slots": self.slots}).encode()]
         if action.type == "metrics":
             # Prometheus text exposition of this worker process's registry
             # (raw bytes, not JSON — scrape via rpc.flight_action_raw)

@@ -66,6 +66,22 @@ class RpcConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Multi-tenant front-door knobs ([serving] TOML section; each field is
+    also overridable per-process via the matching IGLOO_SERVING_* env var —
+    env wins, like [rpc]). See docs/serving.md for semantics.
+
+    None = "not set in the TOML": the numeric defaults live in ONE place —
+    cluster/serving.py's AdmissionController — so a tuned default is never
+    silently shadowed by a stale copy here."""
+    queue_depth: Optional[int] = None          # 0 = serialize (kill switch)
+    max_concurrency: Optional[int] = None
+    session_inflight: Optional[int] = None
+    hbm_budget_bytes: Optional[int] = None
+    weights: Optional[list[int]] = None        # per-priority-tier dequeue
+
+
+@dataclass
 class DistributedConfig:
     """Multi-host JAX runtime (SURVEY #20 "jax distributed init").
 
@@ -93,6 +109,7 @@ class Config:
     cache_budget_bytes: int = 1 << 30
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     rpc: RpcConfig = field(default_factory=RpcConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     distributed: DistributedConfig = field(default_factory=DistributedConfig)
     use_jit: bool = True
 
@@ -135,6 +152,11 @@ class Config:
                   "backoff_jitter", "query_deadline_s"):
             if k in rp:
                 setattr(cfg.rpc, k, rp[k])
+        sv = raw.get("serving", {})
+        for k in ("queue_depth", "max_concurrency", "session_inflight",
+                  "hbm_budget_bytes", "weights"):
+            if k in sv:
+                setattr(cfg.serving, k, sv[k])
         ds = raw.get("distributed", {})
         for k in ("enabled", "coordinator_address", "num_processes",
                   "process_id", "local_device_ids"):

@@ -11,7 +11,9 @@ The built-in `capitalize` UDF mirrors the reference's
 """
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -89,6 +91,12 @@ class QueryEngine:
         # single-device; or an explicit jax.sharding.Mesh
         self._mesh_setting = mesh
         self._mesh = None
+        # per-THREAD demotion overrides (serving degradation ladder,
+        # docs/serving.md): a constrained chunk budget forces the chunked/
+        # GRACE tiers, force_host the numpy tier — thread-local because the
+        # coordinator runs concurrent queries through ONE engine and only
+        # the demoted query must execute constrained
+        self._demote_tls = threading.local()
         # HBM batch cache: scan results stay device-resident across queries
         # (the real version of the reference's unenforced CacheConfig, gap G7)
         self.batch_cache = BatchCache(cache_budget_bytes)
@@ -187,10 +195,11 @@ class QueryEngine:
                 # collection in DETAIL mode: actual per-operator row counts,
                 # per-node wall time, compile/execute split, transfer bytes,
                 # and GRACE per-partition rollups (docs/observability.md)
+                peak0 = stats.device_peak_hbm_bytes()
                 with stats.collect(sql, detail=True) as qs:
                     table = self._execute_plan(plan)
                     qs.rows = table.num_rows
-                self._harvest_adaptive(qs, plan)
+                self._harvest_adaptive(qs, plan, peak_hbm0=peak0)
                 text += "\n-- actual (operator tree):\n"
                 text += stats.render_tree(qs)
                 delta = qs.counters
@@ -226,16 +235,18 @@ class QueryEngine:
             return QueryResult(pa.table({"status": [f"dropped {stmt.name}"]}),
                                elapsed_s=time.perf_counter() - t0)
         if isinstance(stmt, A.SelectStmt):
+            peak0 = stats.device_peak_hbm_bytes()
             with stats.collect(sql) as qs:
                 table, plan = self._run_select(stmt, want_plan=True)
                 qs.rows = table.num_rows
-            self._harvest_adaptive(qs, plan)
+            self._harvest_adaptive(qs, plan, peak_hbm0=peak0)
             return QueryResult(table, plan=plan,
                                elapsed_s=time.perf_counter() - t0, stats=qs)
         raise IglooError(f"unsupported statement {type(stmt).__name__}")
 
     def _harvest_adaptive(self, qs: Optional[stats.QueryStats],
-                          plan: Optional[L.LogicalPlan]) -> None:
+                          plan: Optional[L.LogicalPlan],
+                          peak_hbm0: int = 0) -> None:
         """Fold a finished query's free cardinality observations into the
         process-wide AdaptiveStats store (docs/adaptive.md): per-subtree
         observed rows, the root cardinality, and — when a join AND both of
@@ -246,11 +257,22 @@ class QueryEngine:
         if qs is None or not hints.adaptive_enabled():
             return
         obs = {k: n for k, n in qs.observations if k is not None}
-        if plan is not None and qs.rows is not None:
-            fp = hints.plan_fp(plan)
-            if fp is not None:
-                obs[fp] = int(qs.rows)
-        if not obs:
+        root_fp = hints.plan_fp(plan) if plan is not None else None
+        if root_fp is not None and qs.rows is not None:
+            obs[root_fp] = int(qs.rows)
+        # device-memory watermark for the admission gate (docs/serving.md).
+        # The watermark is process-CUMULATIVE (monotonic), so only a query
+        # that RAISED it (`> peak_hbm0`, the caller's pre-query snapshot)
+        # may record — otherwise every small query after one big one would
+        # inherit the global peak, ratchet its prediction past the serving
+        # budget, and demote forever. The recorded value is still an upper
+        # bound involving this query, which is the right direction.
+        peak_hbm = 0
+        if root_fp is not None:
+            peak_hbm = stats.device_peak_hbm_bytes()
+            if peak_hbm <= peak_hbm0:
+                peak_hbm = 0
+        if not obs and not peak_hbm:
             return
         # the CURRENT process-wide store, not one cached at engine
         # construction: reset_adaptive_store() (tests) would otherwise leave
@@ -258,6 +280,8 @@ class QueryEngine:
         store = hints.adaptive_store()
         for k, n in obs.items():
             store.observe(k, rows=n)
+        if peak_hbm:
+            store.observe(root_fp, peak_hbm_bytes=int(peak_hbm))
         if plan is not None:
             for node in L.walk_plan(plan):
                 if isinstance(node, L.Join):
@@ -268,6 +292,31 @@ class QueryEngine:
                         store.observe(jf, in_rows=obs[lf] + obs[rf])
         store.flush()
         tracing.counter("adaptive.observed", len(obs))
+
+    @contextlib.contextmanager
+    def demoted(self, budget_bytes: Optional[int] = None,
+                force_host: bool = False):
+        """Run the enclosed executions on this thread one rung down the
+        degradation ladder (docs/serving.md): a constrained `budget_bytes`
+        makes `_execute_plan` route over-budget plans to the chunked/GRACE
+        tiers at THAT budget, `force_host` routes supported plans to the
+        numpy host tier regardless of backend. The serving front door uses
+        this when a query hits RESOURCE_EXHAUSTED/MemoryError (or is
+        predicted past the whole HBM budget) instead of failing it."""
+        prev = (getattr(self._demote_tls, "budget", None),
+                getattr(self._demote_tls, "force_host", False))
+        self._demote_tls.budget = budget_bytes
+        self._demote_tls.force_host = force_host
+        try:
+            yield
+        finally:
+            self._demote_tls.budget, self._demote_tls.force_host = prev
+
+    def _chunk_budget(self) -> int:
+        override = getattr(self._demote_tls, "budget", None)
+        if override is not None:
+            return min(int(override), self.chunk_budget_bytes)
+        return self.chunk_budget_bytes
 
     def _resolve_mesh(self):
         """The execution mesh, resolved once: None for single-device."""
@@ -309,7 +358,9 @@ class QueryEngine:
         silently chunking would discard the parallelism."""
         from igloo_tpu.exec.chunked import LocalChunkExecutor, chunk_count
         qs = stats.current()
-        if self._host_route(plan):
+        budget = self._chunk_budget()
+        force_host = getattr(self._demote_tls, "force_host", False)
+        if force_host or self._host_route(plan):
             from igloo_tpu.exec.host import HostExecutor, HostUnsupported
             try:
                 with span("execute"):
@@ -330,12 +381,11 @@ class QueryEngine:
                 # tier, not fail the query
                 tracing.counter("engine.host_route_oom")
         mesh = self._resolve_mesh()
-        chunks = 0 if mesh is not None else \
-            chunk_count(plan, self.chunk_budget_bytes)
+        chunks = 0 if mesh is not None else chunk_count(plan, budget)
         grace_found = None
         if mesh is None and not chunks:
             from igloo_tpu.exec.grace import find_grace_join
-            grace_found = find_grace_join(plan, self.chunk_budget_bytes)
+            grace_found = find_grace_join(plan, budget)
         with span("execute"):
             if chunks:
                 tracing.counter("engine.chunked_route")
@@ -353,7 +403,7 @@ class QueryEngine:
                 return GraceJoinExecutor(
                     self.catalog, self._jit_cache, use_jit=self._use_jit,
                     batch_cache=self.batch_cache, hints=self.hint_store,
-                    budget_bytes=self.chunk_budget_bytes,
+                    budget_bytes=budget,
                 ).execute_to_arrow(plan, grace_found)
             if qs is not None:
                 qs.tier = "sharded" if mesh is not None else "device"

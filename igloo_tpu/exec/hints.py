@@ -133,10 +133,14 @@ class AdaptiveStats(_JsonStore):
       nbuckets   bucket count the sketch was taken at (a sketch only guides
                  salting when the current plan uses the same bucket count —
                  the hash is deterministic per count, not across counts)
+      peak_hbm_bytes  observed device-memory watermark after running the
+                 subtree (an UPPER bound — the watermark is process-
+                 cumulative); the serving admission gate's footprint
+                 prediction (cluster/serving.py, docs/serving.md)
     """
 
     _FIELDS = ("rows", "in_rows", "bytes", "max_share", "hot_bucket",
-               "nbuckets")
+               "nbuckets", "peak_hbm_bytes")
 
     def _coerce(self, raw: dict) -> dict:
         out = {}

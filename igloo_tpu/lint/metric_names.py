@@ -1,10 +1,11 @@
-"""metric-names: tracing counter/histogram names must match the catalog.
+"""metric-names: tracing counter/histogram/gauge names must match the catalog.
 
 Migrated from scripts/check_metrics_names.py into the shared lint framework
 (same rules, same catalog): every ``tracing.counter(...)`` /
-``tracing.histogram(...)`` name used in the package must be covered by the
-catalog in docs/observability.md, so metric names cannot silently drift or
-typo-fork (``pack.hits`` vs ``pack.hit``).
+``tracing.histogram(...)`` / ``tracing.gauge(...)`` / ``tracing.gauge_add
+(...)`` name used in the package must be covered by the catalog in
+docs/observability.md, so metric names cannot silently drift or typo-fork
+(``pack.hits`` vs ``pack.hit``).
 
 Rules:
 - a literal name must be covered by the catalog verbatim (or by a
@@ -34,7 +35,8 @@ DYNAMIC_PREFIXES = {
 }
 
 CALL_RE = re.compile(
-    r"(?:tracing\.)?(?:counter|histogram)\(\s*(f?)[\"']", re.MULTILINE)
+    r"(?:tracing\.)?(?:counter|histogram|gauge|gauge_add)\(\s*(f?)[\"']",
+    re.MULTILINE)
 # metric-name string literals inside one call region (covers ternary arms:
 # counter("a" if ok else "b"))
 NAME_STR_RE = re.compile(

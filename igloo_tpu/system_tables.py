@@ -65,7 +65,7 @@ class _SystemTable:
 
 
 class MetricsTable(_SystemTable):
-    """`system.metrics`: one row per counter, four per histogram
+    """`system.metrics`: one row per counter and gauge, four per histogram
     (count/sum/min/max), straight out of the process registry."""
 
     _arrow_schema = pa.schema([
@@ -87,6 +87,10 @@ class MetricsTable(_SystemTable):
                 names.append(name)
                 kinds.append(f"hist_{part}")
                 values.append(float(h[part]))
+        for name, v in sorted(tracing.gauges().items()):
+            names.append(name)
+            kinds.append("gauge")
+            values.append(float(v))
         return pa.Table.from_arrays(
             [pa.array(names, type=pa.string()),
              pa.array(kinds, type=pa.string()),
@@ -114,6 +118,12 @@ class QueryLogTable(_SystemTable):
         pa.field("jit_misses", pa.int64(), False),
         pa.field("cache_hits", pa.int64(), False),
         pa.field("status", pa.string(), False),
+        # serving-path columns (coordinator front door, docs/serving.md):
+        # admission-queue wait, priority tier, and demotion count (0 =
+        # executed at its planned tier)
+        pa.field("queue_wait_s", pa.float64(), False),
+        pa.field("priority", pa.int64(), False),
+        pa.field("demoted", pa.int64(), False),
     ])
 
     def _build(self) -> pa.Table:

@@ -88,6 +88,13 @@ class QueryStats:
     # terminal outcome: "ok" | "cancelled" | "deadline_exceeded" | "error"
     # (non-ok values come from the distributed tier's deadline/cancel paths)
     status: str = "ok"
+    # serving-path fields (coordinator front door, docs/serving.md): how long
+    # the query waited in the admission queue, its priority tier, and how
+    # many rungs of the degradation ladder it was demoted down (0 = ran at
+    # its planned tier)
+    queue_wait_s: float = 0.0
+    priority: int = 1
+    demoted: int = 0
     # (fingerprint key, observed rows) pairs recorded where a row count was
     # free or already paid for (host tier, detail-mode syncs, first-sight
     # adaptive-input syncs); the engine folds them into the process-wide
@@ -129,6 +136,9 @@ class QueryStats:
             "cache_hits": int(self.counters.get("cache.hit", 0) +
                               self.counters.get("result_cache.hit", 0)),
             "status": self.status,
+            "queue_wait_s": round(self.queue_wait_s, 6),
+            "priority": int(self.priority),
+            "demoted": int(self.demoted),
         }
 
 
@@ -171,6 +181,13 @@ def collect(sql: str = "", detail: bool = False, log: bool = True):
         # an artificial root with a single child is noise — promote the child
         if len(root.children) == 1 and not root.attrs:
             qs.root = root.children[0]
+        # serving-path context (admission wait / priority / demotions) set by
+        # the coordinator front door around an in-process engine execution
+        sv = getattr(_tls, "serving", None)
+        if sv is not None:
+            qs.queue_wait_s = sv.get("queue_wait_s", 0.0)
+            qs.priority = sv.get("priority", 1)
+            qs.demoted = sv.get("demoted", 0)
         _tls.qstats = None
         _tls.opstack = None
         if log:
@@ -341,6 +358,51 @@ def record_upload(nbytes: int) -> None:
         add_transfer(h2d=nbytes)
 
 
+def device_peak_hbm_bytes() -> int:
+    """Peak device-memory watermark across local devices (0 when the backend
+    reports no memory stats — CPU). Process-cumulative, so per-query use of
+    it is an UPPER bound; the admission gate wants conservative."""
+    try:
+        import jax
+        peaks = []
+        for d in jax.local_devices():
+            ms = getattr(d, "memory_stats", None)
+            ms = ms() if callable(ms) else None
+            if ms:
+                peaks.append(ms.get("peak_bytes_in_use",
+                                    ms.get("bytes_in_use", 0)))
+        return int(max(peaks)) if peaks else 0
+    except Exception:
+        return 0
+
+
+# --- serving context ---------------------------------------------------------
+
+
+@contextlib.contextmanager
+def serving_context(queue_wait_s: float = 0.0, priority: int = 1):
+    """Attribute serving-path facts (admission wait, priority tier, ladder
+    demotions via `mark_demoted`) to every query-log record the wrapped
+    in-process execution produces on this thread — the coordinator's LOCAL
+    fallback/demotion paths run through `engine.execute`, whose `collect()`
+    has no other way to learn them."""
+    prev = getattr(_tls, "serving", None)
+    _tls.serving = {"queue_wait_s": float(queue_wait_s),
+                    "priority": int(priority), "demoted": 0}
+    try:
+        yield _tls.serving
+    finally:
+        _tls.serving = prev
+
+
+def mark_demoted() -> None:
+    """Count one degradation-ladder demotion for the current serving context
+    (no-op outside one)."""
+    sv = getattr(_tls, "serving", None)
+    if sv is not None:
+        sv["demoted"] = sv.get("demoted", 0) + 1
+
+
 # --- cross-thread propagation ----------------------------------------------
 
 
@@ -401,11 +463,14 @@ def _append_log(qs: QueryStats) -> None:
 
 def log_query(sql: str, elapsed_s: float, tier: str = "distributed",
               rows: Optional[int] = None, status: str = "ok",
-              started_at: Optional[float] = None) -> QueryStats:
+              started_at: Optional[float] = None,
+              queue_wait_s: float = 0.0, priority: int = 1,
+              demoted: int = 0) -> QueryStats:
     """Append a query-log record for a query NOT executed through
     `collect()` — the coordinator's distributed path logs every query here,
-    including cancelled / deadline-exceeded ones that never finished (their
-    `status` column is how an operator audits what the cluster dropped)."""
+    including cancelled / deadline-exceeded / shed ones that never finished
+    (their `status` column is how an operator audits what the cluster
+    dropped)."""
     global _query_seq
     with _log_lock:
         _query_seq += 1
@@ -413,7 +478,9 @@ def log_query(sql: str, elapsed_s: float, tier: str = "distributed",
     qs = QueryStats(sql=sql, elapsed_s=elapsed_s, tier=tier, rows=rows,
                     status=status, qid=qid,
                     started_at=started_at if started_at is not None
-                    else time.time() - elapsed_s)
+                    else time.time() - elapsed_s,
+                    queue_wait_s=queue_wait_s, priority=priority,
+                    demoted=demoted)
     _append_log(qs)
     return qs
 

@@ -42,7 +42,7 @@ ROOTS_MAX = 64
 # are hit from every thread; a CounterDelta's backing Counter is shared with
 # adopted worker threads (the GRACE prefetch thread), so all `_data` access
 # holds the module-wide _delta_lock
-_GUARDED_BY = {"_lock": ("_counters", "_hists", "_version"),
+_GUARDED_BY = {"_lock": ("_counters", "_hists", "_gauges", "_version"),
                "_delta_lock": ("_data",)}
 
 
@@ -81,6 +81,7 @@ class MetricsRegistry:
     def __init__(self):
         self._counters: Counter = Counter()
         self._hists: dict[str, HistogramData] = {}
+        self._gauges: dict[str, float] = {}
         self._lock = threading.Lock()
         self._version = 0
 
@@ -97,6 +98,22 @@ class MetricsRegistry:
             h.observe(value)
             self._version += 1
 
+    def gauge(self, name: str, value: float) -> None:
+        """Set a last-value-wins gauge (queue depth, busy slots, reserved
+        bytes — instantaneous state, unlike the monotonic counters)."""
+        with self._lock:
+            self._gauges[name] = float(value)
+            self._version += 1
+
+    def gauge_add(self, name: str, delta: float) -> float:
+        """Atomically adjust a gauge by `delta`; returns the new value (the
+        acquire/release call sites would otherwise read-modify-write race)."""
+        with self._lock:
+            v = self._gauges.get(name, 0.0) + delta
+            self._gauges[name] = v
+            self._version += 1
+            return v
+
     def counters(self) -> dict:
         with self._lock:
             return dict(self._counters)
@@ -104,6 +121,10 @@ class MetricsRegistry:
     def histograms(self) -> dict:
         with self._lock:
             return {k: h.as_dict() for k, h in self._hists.items()}
+
+    def gauges(self) -> dict:
+        with self._lock:
+            return dict(self._gauges)
 
     def version(self) -> int:
         with self._lock:
@@ -119,6 +140,7 @@ class MetricsRegistry:
         with self._lock:
             self._counters.clear()
             self._hists.clear()
+            self._gauges.clear()
             self._version += 1
 
 
@@ -148,6 +170,10 @@ def prometheus_text(prefix: str = "igloo", extra_lines: Optional[list] = None
         lines.append(f"{m}_sum {h['sum']}")
         lines.append(f"{m}_min {h['min']}")
         lines.append(f"{m}_max {h['max']}")
+    for name, v in sorted(REGISTRY.gauges().items()):
+        m = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {v}")
     if extra_lines:
         lines.extend(extra_lines)
     return "\n".join(lines) + "\n"
@@ -179,12 +205,26 @@ def histogram(name: str, value: float) -> None:
     REGISTRY.observe(name, value)
 
 
+def gauge(name: str, value: float) -> None:
+    """Set a process-wide gauge to an instantaneous value."""
+    REGISTRY.gauge(name, value)
+
+
+def gauge_add(name: str, delta: float) -> float:
+    """Atomically adjust a process-wide gauge; returns the new value."""
+    return REGISTRY.gauge_add(name, delta)
+
+
 def counters() -> dict:
     return REGISTRY.counters()
 
 
 def histograms() -> dict:
     return REGISTRY.histograms()
+
+
+def gauges() -> dict:
+    return REGISTRY.gauges()
 
 
 def reset_counters() -> None:

@@ -8,9 +8,13 @@ answer CORRECTLY while the failure model is actively exercised —
    not by heartbeat — worker_timeout is set high on purpose),
 3. a HUNG worker (TCP accepts, never answers): the query must complete via
    deadline-driven re-dispatch instead of stalling.
+4. seeded 20% admission-shed injection (`serving.admit` point): every shed
+   query must be retried by the client-side policy and ultimately succeed —
+   overload is bounded latency, never a failure (docs/serving.md).
 
-Asserts recoveries>0, faults actually injected, and every result identical
-to single-node execution. ~20 s on the virtual CPU mesh.
+Asserts recoveries>0, faults actually injected, shed retries engaged, and
+every result identical to single-node execution. ~20 s on the virtual CPU
+mesh.
 """
 import os
 import sys
@@ -19,6 +23,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["IGLOO_TPU_COMPILE_CACHE"] = "0"
+# repeated identical SQL must EXECUTE (this smoke asserts what execution
+# did), not serve from the front-door result cache (docs/serving.md)
+os.environ["IGLOO_SERVING_RESULT_CACHE"] = "0"
 # the fault spec: 10% of execute_fragment actions fail retryably, replayed
 # from a fixed seed so CI failures reproduce exactly
 os.environ["IGLOO_FAULTS"] = "worker.do_action.execute_fragment:error:0.1"
@@ -127,10 +134,32 @@ def main() -> int:
         assert m["recoveries"] >= 1, m
         assert hung_elapsed < 20.0, \
             f"hung worker stalled the query for {hung_elapsed:.1f}s"
+
+        # --- phase 3: seeded 20% admission shed, absorbed by client retry ---
+        from igloo_tpu.cluster import faults
+        faults.install("serving.admit:error:0.2", seed=7)
+        # a generous retry budget: the injected shed is classified
+        # retryable, and the point of the phase is that retries absorb it
+        c3 = DistributedClient(caddr, policy=rpc.default_policy().with_(
+            retries=8, backoff_base_s=0.01))
+        try:
+            shed0 = tracing.counters().get("serving.shed", 0)
+            for run in range(10):
+                got = c3.execute(SQL, deadline_s=60.0)
+                assert got.to_pydict() == want, f"shed run {run}: wrong result"
+            shed = tracing.counters().get("serving.shed", 0) - shed0
+            retried = tracing.counters().get("client.busy_retries", 0) + \
+                tracing.counters().get("rpc.retries", 0)
+            assert shed > 0, "20% shed spec installed but nothing shed"
+            assert retried > 0, "shed queries succeeded without retries?"
+        finally:
+            faults.clear()
+            c3.close()
         client.close()
         print(f"chaos smoke: OK — {recoveries} recoveries under "
               f"{injected} injected faults + worker kill; hung-worker "
-              f"query rescued in {hung_elapsed:.1f}s")
+              f"query rescued in {hung_elapsed:.1f}s; {shed} sheds "
+              "retried to success")
         return 0
     finally:
         hung.shutdown()

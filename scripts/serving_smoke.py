@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Serving smoke (scripts/validate.sh): a burst of concurrent clients
+against a 2-worker cluster with a DELIBERATELY small admission bound must
+complete with ZERO query failures — every query either runs, is
+shed-and-retried to success (the retryable IGLOO_BUSY path), or is demoted
+down the degradation ladder — while overload shows up as bounded latency:
+
+1. 64 concurrent clients vs queue_depth=4 / concurrency=2: zero failures,
+   `serving.shed` > 0 (the bound actually bit), p99 reported and bounded;
+2. a forced-low HBM budget: queries predicted past the whole budget run
+   pre-demoted through the chunked/GRACE ladder (`serving.demoted` > 0)
+   and still return correct results.
+
+~15 s on the virtual CPU mesh. See docs/serving.md.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["IGLOO_TPU_COMPILE_CACHE"] = "0"
+# the bound must BITE: a tiny queue, two slots, and no front-door result
+# cache (cached repeats would dodge admission and prove nothing)
+os.environ["IGLOO_SERVING_QUEUE"] = "4"
+os.environ["IGLOO_SERVING_CONCURRENCY"] = "2"
+os.environ["IGLOO_SERVING_RESULT_CACHE"] = "0"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import threading  # noqa: E402
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+import igloo_tpu.engine as _eng  # noqa: E402
+
+_eng.DEFAULT_MESH = None
+
+from igloo_tpu.catalog import MemTable  # noqa: E402
+from igloo_tpu.cluster.client import DistributedClient  # noqa: E402
+from igloo_tpu.cluster.coordinator import CoordinatorServer  # noqa: E402
+from igloo_tpu.cluster.worker import Worker  # noqa: E402
+from igloo_tpu.engine import QueryEngine  # noqa: E402
+from igloo_tpu.utils import tracing  # noqa: E402
+
+CLIENTS = 64
+SQL = ("SELECT o_cust, SUM(o_total) AS s, COUNT(*) AS n FROM orders "
+       "GROUP BY o_cust ORDER BY o_cust")
+
+
+def same(got: dict, want: dict) -> bool:
+    """Distributed partial aggregation sums floats in a different order
+    than the single-node reference — compare with float tolerance."""
+    if set(got) != set(want):
+        return False
+    for k in want:
+        g, w = got[k], want[k]
+        if len(g) != len(w):
+            return False
+        if k == "s":
+            if not np.allclose(g, w, atol=1e-6):
+                return False
+        elif g != w:
+            return False
+    return True
+
+
+def main() -> int:
+    rng = np.random.default_rng(5)
+    n = 2000
+    orders = pa.table({"o_id": np.arange(n, dtype=np.int64),
+                       "o_cust": rng.integers(0, 32, n),
+                       "o_total": np.round(rng.random(n) * 100, 2)})
+    local = QueryEngine(use_jit=False)
+    local.register_table("orders", MemTable(orders))
+    want = local.execute(SQL).to_pydict()
+
+    coord = CoordinatorServer("grpc+tcp://127.0.0.1:0", worker_timeout_s=60.0,
+                              use_jit=False)
+    caddr = f"127.0.0.1:{coord.port}"
+    workers = [Worker(caddr, port=0, heartbeat_interval_s=1.0, use_jit=False)
+               for _ in range(2)]
+    try:
+        for w in workers:
+            w.start()
+        deadline = time.time() + 20
+        while len(coord.membership.live()) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(coord.membership.live()) == 2, "workers never registered"
+        coord.register_table("orders", MemTable(orders, partitions=2))
+
+        # warm the cluster once so the burst measures serving, not compiles
+        with DistributedClient(caddr) as c:
+            assert same(c.execute(SQL).to_pydict(), want)
+
+        # --- phase 1: 64-client burst vs queue_depth=4 / concurrency=2 ---
+        latencies: list = []
+        failures: list = []
+        lock = threading.Lock()
+
+        def one_client(i: int) -> None:
+            try:
+                with DistributedClient(caddr) as c:
+                    t0 = time.perf_counter()
+                    got = c.execute(SQL, priority=i % 3,
+                                    session=f"tenant{i % 8}",
+                                    busy_wait_s=120.0)
+                    dt = time.perf_counter() - t0
+                if not same(got.to_pydict(), want):
+                    raise AssertionError(f"client {i}: wrong result")
+                with lock:
+                    latencies.append(dt)
+            except Exception as ex:  # zero-failure bar: record, fail below
+                with lock:
+                    failures.append(f"client {i}: {type(ex).__name__}: {ex}")
+
+        threads = [threading.Thread(target=one_client, args=(i,))
+                   for i in range(CLIENTS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        wall = time.perf_counter() - t0
+        assert not failures, "query failures under load:\n" + \
+            "\n".join(failures[:10])
+        assert len(latencies) == CLIENTS, \
+            f"only {len(latencies)}/{CLIENTS} clients finished"
+        shed = tracing.counters().get("serving.shed", 0)
+        assert shed > 0, \
+            "64 clients vs a 4-deep queue never shed — bound not enforced"
+        lat = sorted(latencies)
+        p50 = lat[len(lat) // 2]
+        p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+        assert p99 < 120.0, f"p99 {p99:.1f}s not bounded"
+
+        # --- phase 2: forced-low HBM budget -> degradation ladder ---
+        coord.admission.hbm_budget_bytes = 1 << 12  # 4 KiB: nothing "fits"
+        with DistributedClient(caddr) as c:
+            for _ in range(3):
+                assert same(c.execute(SQL).to_pydict(), want), \
+                    "demoted query returned wrong result"
+        demoted = tracing.counters().get("serving.demoted", 0)
+        assert demoted > 0, \
+            "forced-low HBM budget never drove the demotion ladder"
+
+        print(f"serving smoke: OK — {CLIENTS} clients / 2 workers, "
+              f"queue=4 conc=2: 0 failures, {shed} sheds retried, "
+              f"{demoted} demotions under forced-low HBM budget; "
+              f"p50={p50:.2f}s p99={p99:.2f}s wall={wall:.1f}s")
+        return 0
+    finally:
+        for w in workers:
+            w.shutdown()
+        coord.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

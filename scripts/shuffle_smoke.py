@@ -14,6 +14,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["IGLOO_TPU_COMPILE_CACHE"] = "0"
+# repeated identical SQL must EXECUTE (this smoke asserts what execution
+# did), not serve from the front-door result cache (docs/serving.md)
+os.environ["IGLOO_SERVING_RESULT_CACHE"] = "0"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

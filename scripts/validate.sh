@@ -27,6 +27,9 @@ python scripts/compile_cache_smoke.py
 echo "== adaptive smoke (skew sketch -> salted exchange beats unsalted) =="
 python scripts/adaptive_smoke.py
 
+echo "== serving smoke (64-client burst vs bounded admission queue) =="
+python scripts/serving_smoke.py
+
 echo "== pytest (fast tier, virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q -m "not slow"
 

@@ -446,8 +446,11 @@ def test_injected_drop_mid_stream_surfaces(cluster):
 def test_injected_drop_mid_stream_on_coordinator_relay(cluster):
     """The coordinator's root-result relay is a streaming point too — a
     drop-mid-stream rule on coordinator.do_get kills the relay after one
-    batch, and the client sees the injected failure, not a hang."""
-    client = DistributedClient(cluster["addr"])
+    batch, and a no-retry client sees the injected failure, not a hang
+    (with its default policy the client now absorbs a transient drop by
+    re-fetching from scratch — asserted separately below)."""
+    client = DistributedClient(cluster["addr"],
+                               policy=rpc.default_policy().with_(retries=0))
     try:
         faults.install("coordinator.do_get:drop-mid-stream:1.0:1")
         with pytest.raises(Exception, match="drop-mid-stream"):
@@ -456,6 +459,12 @@ def test_injected_drop_mid_stream_on_coordinator_relay(cluster):
         # the injection consumed its count cap: a re-run streams fully
         _assert_same(client.execute(WIDE_SQL),
                      cluster["local"].execute(WIDE_SQL))
+        # default-policy client: ONE injected drop is absorbed by the
+        # retry-from-scratch (read_all consumed no partial batches)
+        faults.install("coordinator.do_get:drop-mid-stream:1.0:1")
+        with DistributedClient(cluster["addr"]) as retrying:
+            _assert_same(retrying.execute(WIDE_SQL),
+                         cluster["local"].execute(WIDE_SQL))
     finally:
         faults.clear()
         client.close()
