@@ -244,7 +244,7 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
         for k in ("grace_partitions", "grace_pipeline", "counters",
                   "warm_h2d_bytes", "peak_hbm_bytes", "shuffle_buckets",
                   "exchange_bytes", "compile_cache_hits",
-                  "compile_cache_misses", "adaptive"):
+                  "compile_cache_misses", "adaptive", "pallas"):
             if k in rec:
                 block["queries"][q][k] = rec[k]
         log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s "

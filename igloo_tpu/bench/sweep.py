@@ -38,7 +38,8 @@ from igloo_tpu.bench.runner import make_engine  # shared staging helper
 
 _CONVERGENCE_COUNTERS = ("jit.miss", "fused.compact_repair",
                          "join.speculation_overflow",
-                         "join.direct_dup_fallback")
+                         "join.direct_dup_fallback",
+                         "pallas.probe_overflow", "pallas.agg_overflow")
 
 # packed-key fast-path adoption counters (exec/kernels.py planners via the
 # executor/fused compilers): any delta across a query's runs means the
@@ -53,7 +54,18 @@ _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 # not just detected
 _DELTA_PREFIXES = ("jit.", "pack.", "grace.", "chunked.", "xfer.",
                    "cache.", "result_cache.", "engine.", "fused.", "join.",
-                   "exchange.", "compile_cache.", "adaptive.")
+                   "exchange.", "compile_cache.", "adaptive.", "pallas.")
+
+# Pallas kernel names whose dispatch counters feed the per-query `pallas`
+# block (docs/kernels.md); fallback/overflow counters are summed beside
+# them so an A/B against IGLOO_TPU_PALLAS=0 is attributable per query
+_PALLAS_KERNELS = ("probe", "segagg", "gather")
+_PALLAS_FALLBACKS = ("pallas.probe_overflow", "pallas.agg_overflow")
+
+
+def _pallas_enabled() -> bool:
+    from igloo_tpu.exec import dispatch
+    return dispatch.enabled()
 
 
 def _peak_hbm_bytes() -> int:
@@ -143,6 +155,20 @@ def run_query(engine, sql: str, trials: int) -> dict:
         "broadcast": query_delta.get("adaptive.broadcast"),
         "salted": query_delta.get("adaptive.salted"),
         "observed": query_delta.get("adaptive.observed"),
+    }
+    # Pallas kernel dispatch for this query (docs/kernels.md): which
+    # kernels ran, and how often the runtime overflow or eligibility
+    # ladder sent an op back to the sort path — the per-query record for
+    # the IGLOO_TPU_PALLAS=0 A/B (dispatch decisions land in
+    # BENCH_DETAIL.json via bench.py's passthrough)
+    fallbacks = sum(query_delta.get(k) for k in _PALLAS_FALLBACKS)
+    fallbacks += sum(v for k, v in query_delta.values().items()
+                     if k.startswith("pallas.fallback."))
+    rec["pallas"] = {
+        "enabled": _pallas_enabled(),
+        "kernels_used": [k for k in _PALLAS_KERNELS
+                         if query_delta.get(f"pallas.{k}") > 0],
+        "fallbacks": fallbacks,
     }
     joins = query_delta.get("grace.join")
     rec["grace"] = query_delta.get("engine.grace_route") > 0

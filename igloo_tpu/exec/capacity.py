@@ -119,6 +119,18 @@ def capacity_family(limit: int) -> list:
     return out
 
 
+def pow2_block(n: int, cap: int) -> int:
+    """Largest power of two <= min(n, cap) (>= 1). The Pallas kernels
+    (exec/dispatch.py) derive their grid block sizes through this: every
+    canonical capacity is a power of two, so blocks chosen here always
+    divide the padded lane count exactly and kernel programs stay keyed by
+    the same small shape family as the rest of the engine."""
+    b = 1
+    while b * 2 <= min(n, cap):
+        b <<= 1
+    return b
+
+
 def canonical_direct_table(lo: int, hi: int) -> tuple:
     """Canonical (base, table_size) for a direct-join positional table over
     key bounds [lo, hi]. The raw bounds are data-dependent constants; baking

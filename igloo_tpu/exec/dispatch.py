@@ -1,0 +1,241 @@
+"""Pallas kernel dispatch: the ONE gateway to ``exec/pallas_kernels.py``.
+
+Every adoption site (join probe, sort-tier aggregation, batch gathers)
+consults this module at PLAN/TRACE time — a host-side static decision that
+callers fold into their jit cache keys (``cache_token()`` rides every
+``Executor._jitted`` key and the fused program key, per-kernel plans ride
+the per-op fingerprints) — and routes through the ``probe_bounds`` /
+``segagg`` / ``gather_columns`` wrappers below, which are the only legal
+callers of ``pallas_kernels`` (igloo-lint ``pallas-dispatch`` rule: the
+flag and the fallback ladder must not be bypassable).
+
+Knob: ``IGLOO_TPU_PALLAS``
+  - ``auto`` (default)  kernels on TPU backends only, compiled;
+  - ``0``               kernels off everywhere — reproduces the sort-path
+                        plans and results bit-identically;
+  - ``1``               kernels on; on non-TPU backends this implies the
+                        Pallas interpreter (a compiled Pallas call needs
+                        Mosaic/TPU);
+  - ``interpret``       kernels on through the Pallas interpreter on any
+                        backend — the CPU equivalence mode tier-1 uses.
+
+Fallback ladder (each rung attributable): mode off / non-TPU auto -> sort
+path silently; eligibility miss or an earlier failure's negative cache ->
+sort path + ``pallas.fallback.<reason>``; COMPILE failure (a program the
+backend cannot lower) -> caught at the executor's call sites, negative
+cache + sort-path re-run (``pallas.compile_fallback``); runtime overflow
+(probe window / agg table) -> deferred flag -> sort-path re-run +
+negative cache (``pallas.probe_overflow`` / ``pallas.agg_overflow``).
+
+Block shapes and table sizes derive from the canonical capacity families
+(exec/capacity.py): lane capacities are family members (powers of two), so
+``pow2_block`` blocks always divide them and kernel programs are keyed by
+the same small shape family as the rest of the engine.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from igloo_tpu.exec.capacity import canonical_capacity, pow2_block
+from igloo_tpu.utils import tracing
+
+#: empty-slot sentinel in the hash-agg key table (canonical definition —
+#: the kernels module imports it from here); packed key lanes are
+#: mixed-radix digit strings and therefore always >= 0
+EMPTY_KEY = np.int64(-1)
+
+# --- kernel-eligibility bounds --------------------------------------------
+
+#: per-probe-row bucket scan window (bounded ragged emission); a build-side
+#: duplicate-key run longer than this overflows to the sort path
+PROBE_WINDOW = 16
+#: probe rows per grid block
+PROBE_BLOCK = 1024
+#: expected bucket occupancy target: buckets = build_capacity >> this
+PROBE_BUCKET_SHIFT = 3
+#: widest build side the probe kernel accepts in INTERPRET mode (the sorted
+#: hash lane must be kernel-resident); matches the speculative-join budget
+PROBE_MAX_BUILD = 1 << 22
+#: compiled-mode clamp: the resident int64 hash lane must fit VMEM
+#: (~16 MB/core) beside the bucket-starts lane and the probe blocks —
+#: 2^20 lanes = 8 MB. A compile failure IS caught (the executor's
+#: compile-failure rung), but it costs a wasted compile and permanently
+#: bans the op, so the compiled bounds stay conservative.
+PROBE_MAX_BUILD_COMPILED = 1 << 20
+#: bucket-count clamp (the starts lane is a kernel input)
+PROBE_MAX_BUCKETS = 1 << 19
+
+#: the direct-scatter aggregate's "small segment space" bound: at or under
+#: this many segments exec/aggregate.py scatters unconditionally; above it
+#: the scatter path needs a tight aggregate budget and the Pallas hash-agg
+#: table is capped at this many rows — ONE shared constant so the two
+#: eligibility checks cannot drift (see aggregate.seg_dims_for)
+DIRECT_SEG_SMALL_LIMIT = 1 << 16
+
+#: hash-agg bucket ways (bounded collision resolution, the probe-window twin)
+AGG_WAYS = 8
+#: input rows per grid block
+AGG_BLOCK = 1024
+#: compiled-mode table clamp: the key/count/accumulator tables are all
+#: VMEM-resident across grid steps — 2^14 rows keeps a many-aggregate
+#: table set under ~2 MB (see PROBE_MAX_BUILD_COMPILED's rationale)
+AGG_TABLE_ROWS_COMPILED = 1 << 14
+
+#: fused gather: total source bytes the kernel may keep resident
+#: (interpret mode; the compiled clamp keeps the residency under VMEM)
+GATHER_MAX_BYTES = 1 << 25
+GATHER_MAX_BYTES_COMPILED = 1 << 22
+GATHER_BLOCK = 1024
+#: fusing fewer lanes than this is not worth a kernel launch
+GATHER_MIN_COLS = 2
+
+
+def mode() -> str:
+    """Normalized ``IGLOO_TPU_PALLAS``: auto | 0 | 1 | interpret."""
+    raw = os.environ.get("IGLOO_TPU_PALLAS", "auto").strip().lower()
+    return raw if raw in ("0", "1", "interpret") else "auto"
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def kernel_state() -> tuple:
+    """(enabled, interpret) for the current mode + backend + x64 config.
+    The kernels work on int64 hash/key lanes, so a 32-bit-only process
+    never enables them."""
+    m = mode()
+    if m == "0":
+        return False, False
+    import jax
+    if not jax.config.jax_enable_x64:
+        return False, False
+    if m == "interpret":
+        return True, True
+    if m == "1":
+        return True, _backend() != "tpu"
+    return (_backend() == "tpu"), False
+
+
+def enabled() -> bool:
+    return kernel_state()[0]
+
+
+def cache_token() -> tuple:
+    """Rides every jit cache key (Executor._jitted, the fused program key)
+    so flipping IGLOO_TPU_PALLAS mid-process can never serve a program
+    traced under the other mode."""
+    return ("pallas",) + kernel_state()
+
+
+def _fallback(kernel: str, reason: str) -> None:
+    tracing.counter(f"pallas.fallback.{reason}")
+    return None
+
+
+# --- per-kernel planners (host-side; results are hashable cache-key parts) -
+
+def plan_probe(build_cap: int, probe_cap: int,
+               banned: bool = False) -> Optional[tuple]:
+    """Plan the hash-probe kernel for a sorted-probe join, or None for the
+    sort path. `build_cap`/`probe_cap` are canonical lane capacities."""
+    on, interp = kernel_state()
+    if not on:
+        return None
+    if banned:
+        return _fallback("probe", "banned")
+    if build_cap > (PROBE_MAX_BUILD if interp else PROBE_MAX_BUILD_COMPILED):
+        return _fallback("probe", "too_big")
+    nbuckets = min(max(canonical_capacity(build_cap) >> PROBE_BUCKET_SHIFT, 8),
+                   PROBE_MAX_BUCKETS)
+    block = pow2_block(probe_cap, PROBE_BLOCK)
+    tracing.counter("pallas.probe")
+    return ("probe", nbuckets, PROBE_WINDOW, block, interp)
+
+
+def plan_segagg(pack_spec, n_keys: int, input_cap: int,
+                banned: bool = False) -> Optional[tuple]:
+    """Plan the one-pass hash aggregation for a sort-tier GROUP BY, or None.
+    Requires a pack_spec covering EVERY key: the packed lane is then an
+    exact (injective) group id, so table-key equality is group equality
+    with no verify pass. All AggFunc members are supported."""
+    on, interp = kernel_state()
+    if not on:
+        return None
+    if banned:
+        return _fallback("segagg", "banned")
+    if pack_spec is None or len(pack_spec[1]) != n_keys:
+        return _fallback("segagg", "unpackable")
+    # 8x headroom over the input capacity keeps the per-bucket occupancy
+    # low enough that `ways` slots rarely exhaust (overflow falls back)
+    table = min(canonical_capacity(input_cap) * AGG_WAYS,
+                DIRECT_SEG_SMALL_LIMIT if interp
+                else AGG_TABLE_ROWS_COMPILED)
+    nbuckets = max(table // AGG_WAYS, 8)
+    block = pow2_block(input_cap, AGG_BLOCK)
+    tracing.counter("pallas.segagg")
+    return ("segagg", nbuckets, AGG_WAYS, block, interp)
+
+
+def segagg_table_rows(plan: tuple) -> int:
+    """Output capacity of a planned hash aggregation (a family member)."""
+    return plan[1] * plan[2]
+
+
+def _plan_gather(arrays: list, idx) -> Optional[tuple]:
+    """Trace-time static decision for a batch gather; silent fallback (no
+    counters for ineligibility — gathers are everywhere and most are too
+    small or too wide to fuse)."""
+    on, interp = kernel_state()
+    if not on or len(arrays) < GATHER_MIN_COLS:
+        return None
+    if idx.ndim != 1 or any(a.ndim != 1 for a in arrays):
+        return None
+    m = arrays[0].shape[0]
+    if any(a.shape[0] != m for a in arrays):
+        return None
+    n = idx.shape[0]
+    block = pow2_block(n, GATHER_BLOCK)
+    if n % block:
+        return None
+    budget = GATHER_MAX_BYTES if interp else GATHER_MAX_BYTES_COMPILED
+    if sum(a.size * a.dtype.itemsize for a in arrays) > budget:
+        return None
+    tracing.counter("pallas.gather")
+    return ("gather", block, interp)
+
+
+# --- kernel wrappers (jit-traceable; the only pallas_kernels call sites) ---
+
+def probe_bounds(plan: tuple, sorted_hash, probe_hash):
+    """(lower, upper, overflow) — ``join._probe_bounds``'s contract over the
+    ascending-sorted build hash multiset, plus the deferred overflow flag."""
+    from igloo_tpu.exec import pallas_kernels
+    _, nbuckets, window, block, interp = plan
+    return pallas_kernels.hash_probe_bounds(sorted_hash, probe_hash,
+                                            nbuckets, window, block, interp)
+
+
+def segagg(plan: tuple, packed, live, ops: tuple, op_inputs: list):
+    """(key_table, live_counts, per-op tables, overflow) — see
+    ``pallas_kernels.hash_segagg``."""
+    from igloo_tpu.exec import pallas_kernels
+    _, nbuckets, ways, block, interp = plan
+    return pallas_kernels.hash_segagg(packed, live, ops, op_inputs,
+                                      nbuckets, ways, block, interp)
+
+
+def gather_columns(arrays: list, idx) -> list:
+    """Gather every lane in `arrays` by `idx`: the fused Pallas kernel when
+    the mode and shapes allow, one ``jnp.take`` per lane otherwise."""
+    plan = _plan_gather(arrays, idx)
+    if plan is None:
+        import jax.numpy as jnp
+        return [jnp.take(a, idx) for a in arrays]
+    from igloo_tpu.exec import pallas_kernels
+    _, block, interp = plan
+    return pallas_kernels.fused_gather(list(arrays), idx, block, interp)

@@ -27,6 +27,7 @@ import pyarrow as pa
 
 from igloo_tpu import types as T
 from igloo_tpu.errors import ExecError, NotSupportedError, PlanError
+from igloo_tpu.exec import dispatch
 from igloo_tpu.exec import kernels as K
 from igloo_tpu.exec.aggregate import (
     AggSpec, aggregate_batch, distinct_batch, minmax_order_arg, seg_dims_for,
@@ -184,7 +185,11 @@ class Executor:
 
     def _jitted(self, kind: str, fingerprint, build: Callable[[], Callable],
                 static_argnums=()) -> Callable:
-        key = (kind, fingerprint)
+        # the Pallas dispatch token rides EVERY key: implicit dispatch
+        # decisions (the fused gather inside any traced fn) depend on the
+        # IGLOO_TPU_PALLAS mode, so a mid-process flip must never serve a
+        # program traced under the other mode
+        key = (kind, fingerprint, dispatch.cache_token())
         fn = self._cache.get(key)
         if fn is None:
             tracing.counter("jit.miss")
@@ -218,8 +223,9 @@ class Executor:
             vals, svals = jax.device_get(
                 ([f for _, f in deferred], [v for _, v in stat_pairs]))
             self._record_stats(stat_pairs, svals)
-            if self._fired_deferred(deferred, vals):
-                return self._exact_copy().execute(plan)
+            fired = self._fired_deferred(deferred, vals)
+            if fired:
+                return self._retry_copy(fired).execute(plan)
         return batch
 
     def _staged_hint(self, key) -> Optional[int]:
@@ -238,22 +244,61 @@ class Executor:
         if stats and self._hints is not None:
             self._hints.flush()
 
-    def _fired_deferred(self, deferred, vals) -> bool:
-        """Check fetched deferred-flag values; record the negative cache for
-        direct joins whose build side proved to have duplicate keys."""
-        fired = False
+    def _record_fired_tag(self, tag) -> None:
+        """Negative-cache + counter bookkeeping for ONE fired deferred flag —
+        shared by the staged (_fired_deferred) and fused (_fused_run) tiers
+        so a tag kind can never gain handling in one and drift in the other
+        (the cross-tier ban-key lesson of this PR)."""
+        if tag[0] == "dup":
+            # THIS side of the join proved to have duplicate keys — the
+            # other side may still direct-join
+            jfp_core, side = tag[1]
+            self._cache[("nodirect", jfp_core, side)] = True
+            tracing.counter("join.direct_dup_fallback")
+        elif tag[0] == "pallas_probe":
+            # probe window overflow: this join's build side carries longer
+            # duplicate-hash runs than the kernel scans — sort path from
+            # now on
+            self._cache[("nopallas_probe", tag[1])] = True
+            tracing.counter("pallas.probe_overflow")
+        elif tag[0] == "pallas_agg":
+            # hash-table bucket exhaustion: more distinct groups than the
+            # table holds — sort path from now on
+            self._cache[("nopallas_agg", tag[1])] = True
+            tracing.counter("pallas.agg_overflow")
+
+    def _fired_deferred(self, deferred, vals) -> list:
+        """Check fetched deferred-flag values; returns the fired tags (empty
+        = nothing fired), with negative caches recorded."""
+        fired = []
         for (tag, _), v in zip(deferred, vals):
             if bool(v):
-                fired = True
-                if tag[0] == "dup":
-                    jfp_core, side = tag[1]
-                    self._cache[("nodirect", jfp_core, side)] = True
-                    tracing.counter("join.direct_dup_fallback")
+                fired.append(tag)
+                self._record_fired_tag(tag)
         return fired
+
+    def _retry_copy(self, fired_tags) -> "Executor":
+        """The executor to re-run a plan on after `fired_tags` fired. Any
+        speculative-family tag (capacity overflow, direct-join dup, semi
+        window, stale compaction) needs the exact copy. A Pallas-ONLY
+        fallback keeps speculation on: the negative caches just recorded
+        already route the failing op to the sort path, and the plan's
+        speculative joins were not at fault — disabling them would make the
+        repair run pay a count sync per join for nothing. (The sharded
+        tier never plans Pallas kernels, so its _exact_copy override is
+        always the path taken there.)"""
+        if any(t[0] not in ("pallas_probe", "pallas_agg")
+               for t in fired_tags):
+            return self._exact_copy()
+        return Executor(self._cache, use_jit=self._use_jit,
+                        batch_cache=self._batch_cache,
+                        speculate=self._speculate, hints=self._hints)
 
     def _exact_copy(self) -> "Executor":
         """A sibling executor with speculation off (shares all caches); used to
-        re-run a plan after a deferred speculative-join overflow fired."""
+        re-run a plan after a deferred speculative-join overflow fired
+        (Pallas-only fallbacks take _retry_copy's speculation-preserving
+        sibling instead and never reach here)."""
         tracing.counter("join.speculation_overflow")
         return Executor(self._cache, use_jit=self._use_jit,
                         batch_cache=self._batch_cache, speculate=False,
@@ -319,13 +364,22 @@ class Executor:
             big, spec, n_dev, flags, stats_dev = jf(
                 [strip_dicts(b) for b in comp.leaves],
                 comp.pool.device_args())
-        except BaseException:
+        except BaseException as e:
             # an ordinary exception means the compile did NOT hang — clear
             # the strike so transient failures can't poison fusion forever
             # (a process killed mid-compile never reaches this handler)
             if first and self._hints is not None:
                 self._hints.remove(sentinel)
                 self._hints.flush()
+            if comp.pallas_bans and isinstance(e, Exception):
+                # compile-failure rung: ban every Pallas plan this program
+                # contained and recompile on the sort path (an unrelated
+                # error re-raises from the Pallas-free program — the bans
+                # are then conservative, not wrong)
+                for bkey in comp.pallas_bans:
+                    self._cache[bkey] = True
+                tracing.counter("pallas.compile_fallback")
+                return self._fused_run(plan, _retry)
             raise
         if first and self._hints is not None:
             self._hints.remove(sentinel)
@@ -345,17 +399,12 @@ class Executor:
         fired = [comp.flag_tags[fid] for fid, v in flags_h.items() if bool(v)]
         if fired:
             for tag in fired:
-                if tag[0] == "dup":
-                    # negative cache: THIS side of the join proved to have
-                    # duplicate keys — the other side may still direct-join
-                    jfp_core, side = tag[1]
-                    self._cache[("nodirect", jfp_core, side)] = True
-                    tracing.counter("join.direct_dup_fallback")
+                self._record_fired_tag(tag)
             if _retry and all(t[0] == "compact" for t in fired):
                 # stale cardinality hints only: repair with the fresh ones
                 tracing.counter("fused.compact_repair")
                 return self._fused_to_arrow(plan, _retry=False)
-            return self._exact_copy().execute_to_arrow(plan)
+            return self._retry_copy(fired).execute_to_arrow(plan)
         spec = attach_dicts(spec, meta.dicts, meta.bounds)
         if int(n) <= spec.capacity:
             return arrow_from_host(spec, host_live, host_vals, host_nulls)
@@ -389,8 +438,9 @@ class Executor:
                  [c.nulls for c in batch.columns]))
             record_fetch((host_live, host_vals, host_nulls))
             self._record_stats(stat_pairs, svals)
-            if self._fired_deferred(deferred, flags):
-                return self._exact_copy().execute_to_arrow(plan)
+            fired = self._fired_deferred(deferred, flags)
+            if fired:
+                return self._retry_copy(fired).execute_to_arrow(plan)
             return arrow_from_host(batch, host_live, host_vals, host_nulls)
         fp = ("spec_compact", batch_proto_key(batch), cap)
 
@@ -408,8 +458,9 @@ class Executor:
                  [c.nulls for c in spec.columns]))
         record_fetch((host_live, host_vals, host_nulls))
         self._record_stats(stat_pairs, svals)
-        if self._fired_deferred(deferred, flags):
-            return self._exact_copy().execute_to_arrow(plan)
+        fired = self._fired_deferred(deferred, flags)
+        if fired:
+            return self._retry_copy(fired).execute_to_arrow(plan)
         if int(host_n) <= cap:
             return arrow_from_host(spec, host_live, host_vals, host_nulls)
         # overflow: compact to the exact capacity and refetch (clamped to the
@@ -667,21 +718,59 @@ class Executor:
             pack_spec = K.plan_group_packing(groups, comp.pool)
             if pack_spec is not None:
                 tracing.counter("pack.agg")
+        # Pallas one-pass hash aggregation for the sort tier: needs a
+        # full-cover pack (the packed lane is then an exact group id); its
+        # table-overflow flag negative-caches this aggregate onto the sort
+        # path. A host decision -> part of the cache key.
+        pallas_agg = None
+        afp_core = ("agg", expr_fingerprint(gres + ares),
+                    tuple((a.func, a.dtype) for a in aggs))
+        if seg_dims is None and pack_spec is not None:
+            pallas_agg = dispatch.plan_segagg(
+                pack_spec, len(groups), batch.capacity,
+                banned=bool(self._cache.get(("nopallas_agg", afp_core))))
+        def agg_fn(pa):
+            fp = ("agg", expr_fingerprint(gres + ares),
+                  tuple((a.func, a.dtype) for a in aggs),
+                  batch_proto_key(batch), out_schema,
+                  comp.pool.signature(), tuple(comp.marks), seg_dims,
+                  pack_spec, pa)
+
+            def build():
+                def fn(b: DeviceBatch, consts):
+                    if pa is None:
+                        out = aggregate_batch(b, groups, specs, out_schema,
+                                              consts, seg_dims=seg_dims,
+                                              pack_spec=pack_spec)
+                        return out, jnp.zeros((), jnp.bool_)
+                    return aggregate_batch(b, groups, specs, out_schema,
+                                           consts, seg_dims=seg_dims,
+                                           pack_spec=pack_spec,
+                                           pallas_agg=pa)
+                return fn
+            return self._jitted("agg", fp, build)
+
+        try:
+            out, agg_ovf = agg_fn(pallas_agg)(strip_dicts(batch),
+                                              comp.pool.device_args())
+        except Exception:
+            if pallas_agg is None:
+                raise
+            # compile-failure rung (see _exec_join): sort path, negative
+            # cache, attributable
+            self._cache[("nopallas_agg", afp_core)] = True
+            tracing.counter("pallas.compile_fallback")
+            pallas_agg = None
+            out, agg_ovf = agg_fn(None)(strip_dicts(batch),
+                                        comp.pool.device_args())
         stats.annotate(strategy="direct_scatter" if seg_dims is not None
+                       else "pallas_segagg" if pallas_agg is not None
                        else "packed_sort" if pack_spec is not None
                        else "lex_sort")
-        fp = ("agg", expr_fingerprint(gres + ares),
-              tuple((a.func, a.dtype) for a in aggs),
-              batch_proto_key(batch), out_schema,
-              comp.pool.signature(), tuple(comp.marks), seg_dims, pack_spec)
-
-        def build():
-            def fn(b: DeviceBatch, consts) -> DeviceBatch:
-                return aggregate_batch(b, groups, specs, out_schema, consts,
-                                       seg_dims=seg_dims, pack_spec=pack_spec)
-            return fn
-        out = self._jitted("agg", fp, build)(strip_dicts(batch),
-                                             comp.pool.device_args())
+        if pallas_agg is not None:
+            stats.annotate(pallas="segagg")
+            self._deferred_overflow.append((("pallas_agg", afp_core),
+                                            agg_ovf))
         out = attach_dicts(out, [g.out_dict for g in groups] +
                            [s.out_dict for s in specs])
         return self._maybe_shrink(out)
@@ -1021,17 +1110,44 @@ class Executor:
                                 bnds[: len(out.columns)])
 
         stats.annotate(strategy="sorted_probe")
-        probe = self._jitted(
-            "join_probe", fpbase,
-            lambda: (lambda l, r, consts: probe_phase(
-                l, r, use_lk, use_rk, lhx, rhx, consts)))
+        # Pallas hash-probe dispatch (docs/kernels.md): replaces the
+        # combined (m+n)-lane sort inside _probe_bounds; the kernel's
+        # overflow flag rides the deferred protocol and negative-caches
+        # this join onto the sort path when its build side proves to carry
+        # long duplicate-hash runs. The plan is a host decision -> part of
+        # the probe program's cache key.
+        pplan = None
+        if use_lk:
+            pplan = dispatch.plan_probe(
+                right.capacity, left.capacity,
+                banned=bool(self._cache.get(("nopallas_probe", jfp_core))))
+        def probe_fn(pp):
+            return self._jitted(
+                "join_probe", (fpbase, pp),
+                lambda: (lambda l, r, consts: probe_phase(
+                    l, r, use_lk, use_rk, lhx, rhx, consts, probe_plan=pp)))
         expand = self._jitted(
             "join_expand", (fpbase, plan.schema),
             lambda: (lambda l, r, p, match_cap, consts: expand_phase(
                 l, r, p, match_cap, jt, residual, plan.schema, consts)),
             static_argnums=(3,))
 
-        p = probe(ls, rs, consts)
+        try:
+            p = probe_fn(pplan)(ls, rs, consts)
+        except Exception:
+            if pplan is None:
+                raise
+            # compile-failure rung: a Pallas program the backend cannot
+            # lower must fall back to the proven sort path, not fail the
+            # query (an unrelated error re-raises from the sort-path run)
+            self._cache[("nopallas_probe", jfp_core)] = True
+            tracing.counter("pallas.compile_fallback")
+            pplan = None
+            p = probe_fn(None)(ls, rs, consts)
+        if pplan is not None:
+            stats.annotate(pallas="probe")
+            self._deferred_overflow.append((("pallas_probe", jfp_core),
+                                            p.ovf))
         spec_cap = round_capacity(max(left.capacity, right.capacity))
         if (self._speculate and jt is not JoinType.CROSS
                 and spec_cap <= self._SPECULATIVE_JOIN_BUDGET):

@@ -39,6 +39,7 @@ from typing import Callable, Optional
 import jax.numpy as jnp
 
 from igloo_tpu import types as T
+from igloo_tpu.exec import dispatch
 from igloo_tpu.exec import kernels as K
 from igloo_tpu.exec.aggregate import (
     AggSpec, aggregate_batch, distinct_batch, minmax_order_arg, seg_dims_for,
@@ -111,6 +112,10 @@ class FusedCompiler:
         self.hfps: list = []
         self.flag_tags: list = []   # flag id -> ("dup"|"overflow"|"compact", key)
         self.stat_keys: list = []   # stat id -> nhint cache key
+        # negative-cache keys of every Pallas kernel this program planned:
+        # the executor's compile-failure rung bans them all and recompiles
+        # on the sort path when the program fails to lower
+        self.pallas_bans: list = []
 
     # --- side-channel ids -------------------------------------------------
 
@@ -160,7 +165,7 @@ class FusedCompiler:
             return out, spec, n, ctx.flags, ctx.stats
 
         key = ("fused", tuple(self.fps), self.pool.signature(),
-               tuple(self.marks), fetch_cap)
+               tuple(self.marks), fetch_cap, dispatch.cache_token())
         return run, key, meta
 
     # --- dispatch ---------------------------------------------------------
@@ -357,15 +362,36 @@ class FusedCompiler:
                 out_cap += lmeta.capacity
             if jt in (JoinType.RIGHT, JoinType.FULL):
                 out_cap += rmeta.capacity
-        self._push(("join_sorted",) + jfp[1:] + (spec_cap, plan.schema),
+        # Pallas hash-probe dispatch (docs/kernels.md): a host decision, so
+        # it joins the node fingerprint; the kernel's overflow flag rides
+        # the fused flag channel and negative-caches this join onto the
+        # sort path. The tag key uses the STAGED executor's jfp_core format
+        # ("|"-joined exprs + join type) so a fused overflow's ban is
+        # visible to the exact staged re-run and vice versa.
+        pfp_core = ("|".join(repr(e) for e in lres + rres + rres2), jt)
+        pplan = None
+        if use_lk:
+            pplan = dispatch.plan_probe(
+                rmeta.capacity, lmeta.capacity,
+                banned=bool(self.ex._cache.get(("nopallas_probe",
+                                                pfp_core))))
+        self._push(("join_sorted",) + jfp[1:] + (spec_cap, plan.schema,
+                                                 pplan),
                    hint_fp=("join_sorted",) + jfp_core[1:] + (plan.schema,))
         fid = self._new_flag(("overflow", jfp))
+        pfid = None
+        if pplan is not None:
+            pfid = self._new_flag(("pallas_probe", pfp_core))
+            self.pallas_bans.append(("nopallas_probe", pfp_core))
 
         def fn(leaves, consts, ctx):
             lb = lfn(leaves, consts, ctx)
             rb = rfn(leaves, consts, ctx)
-            p = probe_phase(lb, rb, use_lk, use_rk, lhx, rhx, consts)
+            p = probe_phase(lb, rb, use_lk, use_rk, lhx, rhx, consts,
+                            probe_plan=pplan)
             ctx.flags[fid] = p.total > spec_cap
+            if pfid is not None:
+                ctx.flags[pfid] = p.ovf
             return expand_phase(lb, rb, p, spec_cap, jt, residual,
                                 plan.schema, consts)
         return fn, NodeMeta(plan.schema, out_dicts, out_bounds, out_cap)
@@ -495,15 +521,39 @@ class FusedCompiler:
             pack_spec = K.plan_group_packing(groups, self.pool)
             if pack_spec is not None:
                 tracing.counter("pack.agg")
+        # Pallas one-pass hash aggregation (docs/kernels.md): full-cover
+        # pack required; the table-overflow flag rides the fused flag
+        # channel and negative-caches this aggregate onto the sort path.
+        # The tag key mirrors the staged executor's afp_core format so bans
+        # cross the fused/staged boundary.
+        afp_core = ("agg", "|".join(repr(e) for e in gres + ares),
+                    tuple((a.func, a.dtype) for a in plan.aggs))
+        pallas_agg = None
+        if seg_dims is None and pack_spec is not None:
+            pallas_agg = dispatch.plan_segagg(
+                pack_spec, len(groups), meta.capacity,
+                banned=bool(self.ex._cache.get(("nopallas_agg", afp_core))))
+        afid = None
+        if pallas_agg is not None:
+            afid = self._new_flag(("pallas_agg", afp_core))
+            self.pallas_bans.append(("nopallas_agg", afp_core))
         self._push(("agg", tuple(repr(e) for e in gres + ares),
                     tuple((a.func, a.dtype) for a in plan.aggs),
-                    plan.schema, seg_dims, pack_spec))
+                    plan.schema, seg_dims, pack_spec, pallas_agg))
         out_schema = plan.schema
 
         def fn(leaves, consts, ctx):
             b = cfn(leaves, consts, ctx)
-            return aggregate_batch(b, groups, specs, out_schema, consts,
-                                   seg_dims=seg_dims, pack_spec=pack_spec)
+            if pallas_agg is None:
+                return aggregate_batch(b, groups, specs, out_schema, consts,
+                                       seg_dims=seg_dims,
+                                       pack_spec=pack_spec)
+            out, ovf = aggregate_batch(b, groups, specs, out_schema, consts,
+                                       seg_dims=seg_dims,
+                                       pack_spec=pack_spec,
+                                       pallas_agg=pallas_agg)
+            ctx.flags[afid] = ovf
+            return out
         if not groups:
             cap = MIN_CAPACITY
         elif seg_dims is not None:
@@ -511,6 +561,8 @@ class FusedCompiler:
             for d, _off in seg_dims:
                 prod *= d
             cap = round_capacity(prod + 1)
+        elif pallas_agg is not None:
+            cap = dispatch.segagg_table_rows(pallas_agg)
         else:
             cap = meta.capacity
         out_meta = NodeMeta(out_schema,

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from igloo_tpu import types as T
+from igloo_tpu.exec import dispatch
 from igloo_tpu.exec import kernels as K
 from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn, round_capacity
 from igloo_tpu.exec.expr_compile import Compiled, Env
@@ -55,6 +56,11 @@ class _Probe:
     total: jax.Array       # scalar int64
     l_lanes: list          # per-key _KeyLanes on left
     r_lanes: list          # per-key _KeyLanes on right
+    # Pallas probe overflow: some probe row's equal-hash run may extend past
+    # the kernel's scan window — the executor's deferred-flag protocol
+    # discards the result and re-runs the exact sort path. Always False on
+    # the sort path.
+    ovf: jax.Array = None  # scalar bool
 
 
 # pytree registration so _Probe/_KeyLanes cross jit boundaries (probe runs in one
@@ -67,7 +73,7 @@ jax.tree_util.register_pytree_node(
 jax.tree_util.register_pytree_node(
     _Probe,
     lambda p: ((p.perm_r, p.lower, p.counts, p.prefix, p.total,
-                p.l_lanes, p.r_lanes), None),
+                p.l_lanes, p.r_lanes, p.ovf), None),
     lambda aux, ch: _Probe(*ch),
 )
 
@@ -115,8 +121,15 @@ def _key_lanes(batch: DeviceBatch, keys: list[Compiled], hash_idxs: list,
 
 def probe_phase(left: DeviceBatch, right: DeviceBatch,
                 left_keys: list[Compiled], right_keys: list[Compiled],
-                l_hash_idxs=None, r_hash_idxs=None, consts: tuple = ()) -> _Probe:
-    """Jit-traceable. CROSS join = empty key lists (constant key)."""
+                l_hash_idxs=None, r_hash_idxs=None, consts: tuple = (),
+                probe_plan=None) -> _Probe:
+    """Jit-traceable. CROSS join = empty key lists (constant key).
+    `probe_plan` (dispatch.plan_probe, part of the caller's cache key)
+    routes the bounds search through the Pallas hash-probe kernel: the
+    combined (m+n)-lane stable sort of `_probe_bounds` is replaced by a
+    bucketed window scan over the build side's sorted hash lane — which the
+    phase already pays for as `perm_r` — with the kernel's overflow flag
+    surfaced as `_Probe.ovf` (deferred exact re-run)."""
     cap_l, cap_r = left.capacity, right.capacity
     if l_hash_idxs is None:
         l_hash_idxs = [None] * len(left_keys)
@@ -146,12 +159,18 @@ def probe_phase(left: DeviceBatch, right: DeviceBatch,
     sort_key = jnp.where(right.live, r_hash, jnp.iinfo(jnp.int64).max)
     perm_r = jnp.argsort(sort_key, stable=True)
 
-    lower, upper = _probe_bounds(sort_key, l_hash)
+    if probe_plan is not None and left_keys:
+        sorted_hash = jnp.take(sort_key, perm_r)
+        lower, upper, ovf = dispatch.probe_bounds(probe_plan, sorted_hash,
+                                                  l_hash)
+    else:
+        lower, upper = _probe_bounds(sort_key, l_hash)
+        ovf = jnp.zeros((), jnp.bool_)
     counts = jnp.where(left.live, (upper - lower).astype(jnp.int64), 0)
     prefix = jnp.cumsum(counts) - counts
     total = jnp.sum(counts)
     return _Probe(perm_r, lower, counts.astype(jnp.int32),
-                  prefix.astype(jnp.int64), total, l_lanes, r_lanes)
+                  prefix.astype(jnp.int64), total, l_lanes, r_lanes, ovf)
 
 
 def _probe_bounds(build_key: jax.Array, probe_key: jax.Array):

@@ -316,6 +316,30 @@ def pack_key_lane(spec: tuple, vals: list, nulls: list,
     return acc
 
 
+def unpack_key_digits(spec: tuple, packed: jax.Array, consts: tuple):
+    """Inverse of `pack_key_lane` for the all-ascending nulls-first encoding
+    `plan_group_packing` emits: packed int lane -> ([per-key value lanes],
+    [per-key null flags]). Digit 0 is NULL; otherwise value = digit - 1 +
+    offset. Used by the Pallas hash-aggregate path to decode group key
+    columns straight from the stored table keys (the packed lane is a
+    bijection of its digit string, so no first-occurrence scatter)."""
+    lane_tag, oidx, digits = spec
+    offsets = consts[oidx]
+    acc = packed.astype(jnp.int64)
+    strides = []
+    s = 1
+    for card, _asc, _nf in reversed(digits):
+        strides.append(s)
+        s *= card
+    strides.reverse()
+    vals, nulls = [], []
+    for i, ((card, _asc, _nf), st) in enumerate(zip(digits, strides)):
+        d = (acc // np.int64(st)) % np.int64(card)
+        nulls.append(d == 0)
+        vals.append(d - 1 + offsets[i])
+    return vals, nulls
+
+
 def packed_sort_key(packed: jax.Array, live: jax.Array) -> jax.Array:
     """Displace dead rows to the dtype max so one argsort orders live rows by
     key AND sorts dead rows last. Digits use at most 62 (int64) / 30 (int32)
@@ -399,13 +423,33 @@ def compact_perm(live: jax.Array) -> jax.Array:
     return jnp.argsort(~live, stable=True)
 
 
+def _gather_arrays(arrays: list, idx: jax.Array) -> list:
+    """All-lane gather through the Pallas dispatch layer: one fused kernel
+    materializing every output lane when the mode and shapes allow, one
+    jnp.take (XLA gather) per lane otherwise."""
+    from igloo_tpu.exec import dispatch
+    return dispatch.gather_columns(arrays, idx)
+
+
 def apply_perm(batch: DeviceBatch, perm: jax.Array) -> DeviceBatch:
-    cols = []
+    arrays = []
     for c in batch.columns:
-        vals = jnp.take(c.values, perm)
-        nulls = jnp.take(c.nulls, perm) if c.nulls is not None else None
+        arrays.append(c.values)
+        if c.nulls is not None:
+            arrays.append(c.nulls)
+    arrays.append(batch.live)
+    out = _gather_arrays(arrays, perm)
+    cols = []
+    i = 0
+    for c in batch.columns:
+        vals = out[i]
+        i += 1
+        nulls = None
+        if c.nulls is not None:
+            nulls = out[i]
+            i += 1
         cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
-    return DeviceBatch(batch.schema, cols, jnp.take(batch.live, perm))
+    return DeviceBatch(batch.schema, cols, out[i])
 
 
 def gather_batch(batch: DeviceBatch, idx: jax.Array,
@@ -413,11 +457,22 @@ def gather_batch(batch: DeviceBatch, idx: jax.Array,
                  null_pad: bool = False) -> list[DeviceColumn]:
     """Gather rows of all columns by `idx`. When `null_pad` and valid is given,
     out-of-match rows become NULL (outer-join padding)."""
-    cols = []
     safe = jnp.clip(idx, 0, batch.capacity - 1)
+    arrays = []
     for c in batch.columns:
-        vals = jnp.take(c.values, safe)
-        nulls = jnp.take(c.nulls, safe) if c.nulls is not None else None
+        arrays.append(c.values)
+        if c.nulls is not None:
+            arrays.append(c.nulls)
+    out = _gather_arrays(arrays, safe)
+    cols = []
+    i = 0
+    for c in batch.columns:
+        vals = out[i]
+        i += 1
+        nulls = None
+        if c.nulls is not None:
+            nulls = out[i]
+            i += 1
         if null_pad and valid is not None:
             pad = ~valid
             nulls = pad if nulls is None else (nulls | pad)

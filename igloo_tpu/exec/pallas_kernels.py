@@ -1,0 +1,376 @@
+"""Pallas TPU kernels for the join/agg hot loop (ROADMAP item 5).
+
+The engine is sort-based end to end: every equi-join pays `_probe_bounds`'s
+full (m+n)-lane argsort and every sort-tier GROUP BY pays a multi-lane lex
+sort before segment reduction. These kernels attack the three hottest
+primitives with the ragged-output idiom from "Ragged Paged Attention"
+(PAPERS.md) — a grid over fixed-size blocks with per-block valid counts and
+bounded per-row emission windows, overflow reported as a deferred flag the
+executor repairs with an exact sort-path re-run:
+
+- ``hash_probe_bounds``: the build side's key-hash lane is sorted ONCE
+  (m lanes — the argsort the caller already pays for ``perm_r``) and
+  bucketed by the hash's top bits, so bucket order == sort order and every
+  bucket is a contiguous run. The probe kernel then scans a bounded
+  ``window`` of its bucket per probe row — equality-only compares, since
+  equal hashes are contiguous in sorted order — replacing the combined
+  (m+n)-lane stable sort of ``join._probe_bounds`` with one bandwidth-bound
+  pass over the probe side. A run that may extend past the window raises
+  the overflow flag (exact semantics in ``_probe_kernel``).
+
+- ``hash_segagg``: one-pass blocked hash aggregation over an EXACT integer
+  group-key lane (the ``kernels.pack_key_lane`` packed lane — injective, so
+  slot-key equality IS group equality, no verify pass). A ``ways``-slot
+  bucket per hash gives bounded collision resolution; every aggregate
+  accumulates into the VMEM-resident table in the same pass over the input,
+  replacing the ``lex_argsort -> group_segments -> seg_*`` chain with one
+  read of the input. Bucket exhaustion (more distinct keys than slots)
+  raises the overflow flag.
+
+- ``fused_gather``: one kernel materializing every output lane of a batch
+  gather (``kernels.gather_batch`` / ``apply_perm``) instead of one XLA
+  gather per lane — the index block is read once and all columns gather
+  against it.
+
+Block shapes and table sizes are chosen by ``exec/dispatch.py`` from the
+canonical capacity families (exec/capacity.py), so kernel programs are keyed
+by the same small shape family as the rest of the engine and the compile
+cache converges. ``interpret=True`` runs the kernels through the Pallas
+interpreter on CPU — that is how tier-1 asserts equivalence without
+hardware (``IGLOO_TPU_PALLAS=interpret``).
+
+Access policy: ``exec/dispatch.py`` is the ONLY legal caller (igloo-lint
+``pallas-dispatch`` rule) — the flag and the fallback ladder must not be
+bypassable.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from igloo_tpu.exec.dispatch import EMPTY_KEY
+
+# "no position yet" sentinel in the min/max winner-position tables
+_BIG_POS = np.int32(1 << 30)
+
+
+def _bucket_of(h: jax.Array, bits: int) -> jax.Array:
+    """Bucket id of an int64 hash: its top `bits` bits in SIGN-BIASED
+    (unsigned) order, so ascending bucket id == ascending int64 sort order
+    and each bucket is a contiguous run of the sorted hash lane."""
+    u = h.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+    return jax.lax.shift_right_logical(
+        u, np.uint64(64 - bits)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# 1. hash probe with ragged output
+# ---------------------------------------------------------------------------
+
+def _probe_kernel(starts_ref, hash_ref, probe_ref, lo_ref, cnt_ref, ovf_ref,
+                  *, bits: int, window: int, bsteps: int):
+    """One probe block: per row, an in-bucket binary search finds the
+    probe's insertion point (`bsteps` static iterations cover the longest
+    possible bucket), then a bounded `window` scan counts the equal-hash
+    run — contiguous because the lane is sorted, so equality compares
+    suffice. `lower` equals the sort path's left insertion position for
+    EVERY row (matched or not).
+
+    Overflow is exact: it fires only when the probe's OWN run extends past
+    the window (one lookahead slot distinguishes a run of exactly `window`
+    from a truncated one). Long runs of other keys in the same bucket —
+    including the dead-row MAX-sentinel run and the displaced-NULL runs,
+    which share one hash value each — never flag.
+
+    Hashes compare with the LOW BIT DROPPED (& -2), matching
+    ``join._probe_bounds``'s 63-bit semantics (its low bit carries the side
+    tag): the kernel's bounds are then bit-identical to the sort path's —
+    same candidate sets, totals, and match capacities — and the extra
+    candidates a dropped bit admits die in exact verification like they
+    always have. Masked-equal values differ only in bit 0, so their run is
+    still contiguous in the full-value sort order."""
+    mask = np.int64(-2)
+    h = probe_ref[...]
+    hm = h & mask
+    b = _bucket_of(h, bits)
+    starts = starts_ref[...]
+    s = jnp.take(starts, b)
+    e = jnp.take(starts, b + 1)
+    table = hash_ref[...]
+    m = table.shape[0]
+    lo, hi = s, e
+    for _ in range(bsteps):
+        cond = lo < hi
+        mid = (lo + hi) >> 1
+        less = (jnp.take(table, jnp.clip(mid, 0, m - 1)) & mask) < hm
+        lo = jnp.where(cond & less, mid + 1, lo)
+        hi = jnp.where(cond & ~less, mid, hi)
+    blk = h.shape[0]
+    cnt = jnp.zeros((blk,), jnp.int32)
+    eq_last = jnp.zeros((blk,), bool)
+    for off in range(window):
+        pos = lo + off
+        eq = (pos < e) & \
+            ((jnp.take(table, jnp.clip(pos, 0, m - 1)) & mask) == hm)
+        cnt = cnt + eq.astype(jnp.int32)
+        if off == window - 1:
+            eq_last = eq
+    look = lo + window
+    ovf = eq_last & (look < e) & \
+        ((jnp.take(table, jnp.clip(look, 0, m - 1)) & mask) == hm)
+    lo_ref[...] = lo
+    cnt_ref[...] = cnt
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        ovf_ref[...] = jnp.zeros_like(ovf_ref)
+
+    ovf_ref[...] = ovf_ref[...] | jnp.any(ovf)
+
+
+def hash_probe_bounds(sorted_hash: jax.Array, probe_hash: jax.Array,
+                      nbuckets: int, window: int, block: int,
+                      interpret: bool):
+    """(lower, upper, overflow) of each probe hash's equal-key run in the
+    ASCENDING-sorted build hash multiset `sorted_hash` — exactly
+    ``join._probe_bounds``'s contract (lower/upper are left/right insertion
+    positions, equal when there is no match). `overflow` is a scalar device
+    bool: True means some probe row's run extends past the window and the
+    result must be discarded (the dispatch layer's deferred-flag protocol
+    re-runs the exact sort path)."""
+    m = sorted_hash.shape[0]
+    n = probe_hash.shape[0]
+    bits = int(nbuckets).bit_length() - 1
+    # bucket starts: one O(m) segment count over the already-sorted lane —
+    # bucket-major order IS sort order, so starts[b] .. starts[b+1] is
+    # bucket b's contiguous run
+    counts = jax.ops.segment_sum(jnp.ones((m,), jnp.int32),
+                                 _bucket_of(sorted_hash, bits),
+                                 num_segments=nbuckets)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(counts).astype(jnp.int32)])
+    kernel = functools.partial(_probe_kernel, bits=bits, window=window,
+                               bsteps=int(m).bit_length())
+    lower, cnt, ovf = pl.pallas_call(
+        kernel,
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec((nbuckets + 1,), lambda i: (0,)),
+                  pl.BlockSpec((m,), lambda i: (0,)),
+                  pl.BlockSpec((block,), lambda i: (i,))],
+        out_specs=[pl.BlockSpec((block,), lambda i: (i,)),
+                   pl.BlockSpec((block,), lambda i: (i,)),
+                   pl.BlockSpec((1,), lambda i: (0,))],
+        out_shape=[jax.ShapeDtypeStruct((n,), jnp.int32),
+                   jax.ShapeDtypeStruct((n,), jnp.int32),
+                   jax.ShapeDtypeStruct((1,), jnp.bool_)],
+        interpret=interpret,
+    )(starts, sorted_hash, probe_hash)
+    return lower, lower + cnt, ovf[0]
+
+
+# ---------------------------------------------------------------------------
+# 2. one-pass blocked hash aggregation
+# ---------------------------------------------------------------------------
+
+# kernel op vocabulary: ("count",) consumes [valid]; ("sum",) consumes
+# [valid, value] and accumulates in the value's dtype; ("min",)/("max",)
+# consume [valid, lane] and emit (best lane, winning row position)
+_OP_NIN = {"count": 1, "sum": 2, "min": 2, "max": 2}
+_OP_NOUT = {"count": 1, "sum": 1, "min": 2, "max": 2}
+
+
+def _ident_for(op: str, dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if op == "min" else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if op == "min" else info.min, dtype)
+
+
+def _segagg_kernel(*refs, ops: tuple, nbuckets: int, ways: int, block: int):
+    n_in = 2 + sum(_OP_NIN[op] for op in ops)
+    packed_ref, live_ref = refs[0], refs[1]
+    in_refs = refs[2:n_in]
+    key_ref, cnt_ref = refs[n_in], refs[n_in + 1]
+    out_refs = refs[n_in + 2:-1]
+    ovf_ref = refs[-1]
+    table_rows = nbuckets * ways
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        key_ref[...] = jnp.full_like(key_ref, EMPTY_KEY)
+        cnt_ref[...] = jnp.zeros_like(cnt_ref)
+        ovf_ref[...] = jnp.zeros_like(ovf_ref)
+        oi = 0
+        for op in ops:
+            if op == "count" or op == "sum":
+                out_refs[oi][...] = jnp.zeros_like(out_refs[oi])
+                oi += 1
+            else:  # min / max: identity lane + "no winner yet" positions
+                out_refs[oi][...] = jnp.full_like(
+                    out_refs[oi], _ident_for(op, out_refs[oi].dtype))
+                out_refs[oi + 1][...] = jnp.full_like(out_refs[oi + 1],
+                                                      _BIG_POS)
+                oi += 2
+
+    pk = packed_ref[...].astype(jnp.int64)
+    lv = live_ref[...]
+    # full splitmix64 finalizer for the bucket base (the packed lane is a
+    # dense digit string; weakly-mixed low bits would pile correlated
+    # groups into a few buckets and exhaust their ways)
+    ux = pk.astype(jnp.uint64)
+    ux = ux ^ (ux >> np.uint64(30))
+    ux = ux * np.uint64(0xBF58476D1CE4E5B9)
+    ux = ux ^ (ux >> np.uint64(27))
+    ux = ux * np.uint64(0x94D049BB133111EB)
+    ux = ux ^ (ux >> np.uint64(31))
+    base = (ux.astype(jnp.int64) & np.int64(nbuckets - 1)).astype(jnp.int32) \
+        * np.int32(ways)
+
+    keys = key_ref[...]
+    rem = lv
+    place = jnp.zeros(pk.shape, jnp.int32)
+    placed = jnp.zeros(pk.shape, bool)
+    # search phase: the key may already be stored anywhere in its bucket
+    for way in range(ways):
+        tgt = base + way
+        hit = rem & (jnp.take(keys, tgt) == pk)
+        place = jnp.where(hit, tgt, place)
+        placed = placed | hit
+        rem = rem & ~hit
+    # insert phase: claim the first EMPTY slot (scatter-max arbitrates
+    # same-slot races; losers retry the next way). Occupied slots are never
+    # overwritten — only rows that saw EMPTY attempt the claim, and a row
+    # whose key was just claimed by an equal-key sibling matches on re-read.
+    for way in range(ways):
+        tgt = base + way
+        stored0 = jnp.take(keys, tgt)
+        attempt = rem & (stored0 == EMPTY_KEY)
+        keys = keys.at[jnp.where(attempt, tgt, table_rows)].max(
+            pk, mode="drop")
+        hit = rem & (jnp.take(keys, tgt) == pk)
+        place = jnp.where(hit, tgt, place)
+        placed = placed | hit
+        rem = rem & ~hit
+    key_ref[...] = keys
+    # bucket exhausted for some live row: the whole result is invalid
+    ovf_ref[...] = ovf_ref[...] | jnp.any(rem)
+
+    live_tgt = jnp.where(placed, place, table_rows)
+    cnt_ref[...] = cnt_ref[...].at[live_tgt].add(
+        jnp.ones(pk.shape, jnp.int64), mode="drop")
+
+    pos = (pl.program_id(0) * block +
+           jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0])
+    ri = 0
+    oi = 0
+    for op in ops:
+        valid = in_refs[ri][...]
+        tgt = jnp.where(placed & valid, place, table_rows)
+        if op == "count":
+            out_refs[oi][...] = out_refs[oi][...].at[tgt].add(
+                jnp.ones(pk.shape, jnp.int64), mode="drop")
+        elif op == "sum":
+            val = in_refs[ri + 1][...]
+            out_refs[oi][...] = out_refs[oi][...].at[tgt].add(
+                val, mode="drop")
+        else:  # min / max, with winner-position tracking
+            val = in_refs[ri + 1][...]
+            cur = out_refs[oi][...]
+            red = cur.at[tgt].min(val, mode="drop") if op == "min" \
+                else cur.at[tgt].max(val, mode="drop")
+            # a strictly better value invalidates earlier winners'
+            # positions; equal values keep the smallest position (the sort
+            # path's "first winning row" tie-break)
+            improved = red < cur if op == "min" else red > cur
+            post = out_refs[oi + 1][...]
+            post = jnp.where(improved, _BIG_POS, post)
+            cand = placed & valid & (val == jnp.take(red, place))
+            post = post.at[jnp.where(cand, place, table_rows)].min(
+                pos, mode="drop")
+            out_refs[oi][...] = red
+            out_refs[oi + 1][...] = post
+        ri += _OP_NIN[op]
+        oi += _OP_NOUT[op]
+
+
+def hash_segagg(packed: jax.Array, live: jax.Array, ops: tuple,
+                op_inputs: list, nbuckets: int, ways: int, block: int,
+                interpret: bool):
+    """One-pass blocked hash aggregation. `packed` is an EXACT int group-key
+    lane (>= 0; ``kernels.pack_key_lane``), `ops` a static tuple over the
+    vocabulary above, `op_inputs` the matching flat list of [capacity]
+    arrays. Returns (key_table, live_count_table, [per-op tables...],
+    overflow) where tables have `nbuckets * ways` rows; `overflow` True
+    means some bucket ran out of ways and the caller must fall back to the
+    sort path."""
+    n = packed.shape[0]
+    table_rows = nbuckets * ways
+    kernel = functools.partial(_segagg_kernel, ops=ops, nbuckets=nbuckets,
+                               ways=ways, block=block)
+    blk_spec = pl.BlockSpec((block,), lambda i: (i,))
+    tbl_spec = pl.BlockSpec((table_rows,), lambda i: (0,))
+    out_specs = [tbl_spec, tbl_spec]
+    out_shape = [jax.ShapeDtypeStruct((table_rows,), jnp.int64),
+                 jax.ShapeDtypeStruct((table_rows,), jnp.int64)]
+    ii = 0
+    for op in ops:
+        if op == "count":
+            out_specs.append(tbl_spec)
+            out_shape.append(jax.ShapeDtypeStruct((table_rows,), jnp.int64))
+        elif op == "sum":
+            out_specs.append(tbl_spec)
+            out_shape.append(jax.ShapeDtypeStruct(
+                (table_rows,), op_inputs[ii + 1].dtype))
+        else:
+            out_specs.extend([tbl_spec, tbl_spec])
+            out_shape.extend([
+                jax.ShapeDtypeStruct((table_rows,), op_inputs[ii + 1].dtype),
+                jax.ShapeDtypeStruct((table_rows,), jnp.int32)])
+        ii += _OP_NIN[op]
+    out_specs.append(pl.BlockSpec((1,), lambda i: (0,)))
+    out_shape.append(jax.ShapeDtypeStruct((1,), jnp.bool_))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(n // block,),
+        in_specs=[blk_spec, blk_spec] + [blk_spec] * len(op_inputs),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(packed.astype(jnp.int64), live, *op_inputs)
+    return outs[0], outs[1], list(outs[2:-1]), outs[-1][0]
+
+
+# ---------------------------------------------------------------------------
+# 3. fused multi-column gather
+# ---------------------------------------------------------------------------
+
+def _gather_kernel(idx_ref, *refs, ncols: int):
+    idx = idx_ref[...]
+    for k in range(ncols):
+        src = refs[k][...]
+        refs[ncols + k][...] = jnp.take(
+            src, jnp.clip(idx, 0, src.shape[0] - 1))
+
+
+def fused_gather(cols: list, idx: jax.Array, block: int,
+                 interpret: bool) -> list:
+    """Gather every lane in `cols` by the shared index vector in ONE kernel:
+    the index block is read once per grid step and all columns gather
+    against it (vs one XLA gather op — one full pass over `idx` — per
+    lane). Out-of-range indices clamp, matching ``jnp.take``'s default."""
+    n = idx.shape[0]
+    kernel = functools.partial(_gather_kernel, ncols=len(cols))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec((block,), lambda i: (i,))] +
+                 [pl.BlockSpec(c.shape, lambda i: (0,)) for c in cols],
+        out_specs=[pl.BlockSpec((block,), lambda i: (i,)) for _ in cols],
+        out_shape=[jax.ShapeDtypeStruct((n,), c.dtype) for c in cols],
+        interpret=interpret,
+    )(idx, *cols)
+    return list(outs)

@@ -31,7 +31,12 @@ visitors (docs/static_analysis.md has the rule catalog):
 - ``rpc-policy``      no ``flight.connect`` / ``FlightClient`` outside
                       ``cluster/rpc.py`` — every Flight connection must run
                       under the RPC policy (deadlines, retry/backoff), or a
-                      hung peer wedges the calling thread forever.
+                      hung peer wedges the calling thread forever;
+- ``pallas-dispatch`` no ``exec/pallas_kernels`` import outside
+                      ``exec/dispatch.py`` — every Pallas kernel call must
+                      run under the dispatch layer (IGLOO_TPU_PALLAS flag,
+                      eligibility checks, overflow fallback ladder), or the
+                      kill switch stops being trustworthy.
 
 Suppress a finding with a trailing ``# lint: allow(<rule>)`` comment on the
 offending line (or a standalone allow-comment on the line directly above);
@@ -143,11 +148,12 @@ def default_checkers() -> list:
     from igloo_tpu.lint.jit_key import JitKeyChecker
     from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
     from igloo_tpu.lint.metric_names import MetricNamesChecker
+    from igloo_tpu.lint.pallas_dispatch import PallasDispatchChecker
     from igloo_tpu.lint.rpc_policy import RpcPolicyChecker
     from igloo_tpu.lint.sync_hazard import SyncHazardChecker
     return [SyncHazardChecker(), CacheKeyChecker(), JitKeyChecker(),
             LockDisciplineChecker(), MetricNamesChecker(),
-            RpcPolicyChecker()]
+            RpcPolicyChecker(), PallasDispatchChecker()]
 
 
 def run_lint(paths: Optional[list] = None, checkers: Optional[list] = None,

@@ -1,0 +1,78 @@
+"""pallas-dispatch: every Pallas kernel call must go through exec/dispatch.py.
+
+The ``IGLOO_TPU_PALLAS`` flag, the eligibility checks, the negative caches
+fed by runtime overflow flags, and the ``pallas.*`` counters all live in
+``exec/dispatch.py`` (docs/kernels.md#fallback-ladder). A direct import of
+``exec/pallas_kernels`` anywhere else creates a call path that bypasses the
+flag AND the fallback ladder: the kernel then runs with no sort-path escape
+on overflow and no attribution — exactly the hole that would make
+``IGLOO_TPU_PALLAS=0`` stop being a trustworthy kill switch. This checker
+flags every import form of the kernels module in every package module
+except the dispatch site.
+
+Scope is the package only: tests and scripts legitimately reach the
+kernels directly for kernel-level equivalence assertions.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from igloo_tpu.lint import Checker, Finding, LintModule
+
+RULE = "pallas-dispatch"
+
+#: the ONE module allowed to call into the Pallas kernels
+DISPATCH_SITE = "igloo_tpu/exec/dispatch.py"
+
+KERNELS_MODULE = "igloo_tpu.exec.pallas_kernels"
+
+_MSG = ("direct pallas_kernels import bypasses the dispatch layer "
+        "(IGLOO_TPU_PALLAS flag, eligibility checks, overflow fallback "
+        "ladder, pallas.* counters) — route through igloo_tpu.exec.dispatch")
+
+
+def _resolve_from(relpath: str, level: int, module):
+    """Absolute dotted module a `from ... import` refers to: `level` dots
+    climb packages from the importing file's package (PEP 328), so
+    `from .pallas_kernels import x` inside igloo_tpu/exec/foo.py resolves
+    to igloo_tpu.exec.pallas_kernels."""
+    if not level:
+        return module or ""
+    pkg = relpath.rsplit("/", 1)[0].split("/") if "/" in relpath else []
+    if level > 1:
+        pkg = pkg[: len(pkg) - (level - 1)]
+    base = ".".join(pkg)
+    if not module:
+        return base
+    return f"{base}.{module}" if base else module
+
+
+class PallasDispatchChecker(Checker):
+    name = RULE
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        if mod.relpath == DISPATCH_SITE or \
+                not mod.relpath.startswith("igloo_tpu/"):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == KERNELS_MODULE:
+                        yield Finding(RULE, mod.relpath, node.lineno,
+                                      f"`import {a.name}`: {_MSG}")
+            elif isinstance(node, ast.ImportFrom):
+                # absolute AND relative forms resolve to one dotted path
+                target = _resolve_from(mod.relpath, node.level or 0,
+                                       node.module)
+                if target == KERNELS_MODULE:
+                    yield Finding(RULE, mod.relpath, node.lineno,
+                                  f"`from {node.module or '.'} "
+                                  f"import ...`: {_MSG}")
+                elif target == "igloo_tpu.exec":
+                    for a in node.names:
+                        if a.name == "pallas_kernels":
+                            yield Finding(
+                                RULE, mod.relpath, node.lineno,
+                                f"`from {node.module or '.'} import "
+                                f"pallas_kernels`: {_MSG}")

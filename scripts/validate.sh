@@ -30,6 +30,9 @@ python scripts/adaptive_smoke.py
 echo "== serving smoke (64-client burst vs bounded admission queue) =="
 python scripts/serving_smoke.py
 
+echo "== pallas smoke (interpret-mode kernel equivalence vs sort path) =="
+python scripts/pallas_smoke.py
+
 echo "== pytest (fast tier, virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q -m "not slow"
 

@@ -1,0 +1,9 @@
+"""Mirror of the real exec/dispatch.py exemption: the dispatch site itself
+is the ONE module allowed to import the Pallas kernels."""
+from igloo_tpu.exec import pallas_kernels
+
+
+def probe_bounds(plan, sorted_hash, probe_hash):
+    _, nbuckets, window, block, interp = plan
+    return pallas_kernels.hash_probe_bounds(sorted_hash, probe_hash,
+                                            nbuckets, window, block, interp)

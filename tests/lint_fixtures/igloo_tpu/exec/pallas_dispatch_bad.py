@@ -1,0 +1,15 @@
+"""pallas-dispatch must-flag fixture: every import form of the kernels
+module outside exec/dispatch.py is a finding."""
+import igloo_tpu.exec.pallas_kernels  # BAD: plain import
+from igloo_tpu.exec.pallas_kernels import hash_probe_bounds  # BAD: from-import
+from igloo_tpu.exec import pallas_kernels as pk  # BAD: aliased from-import
+from .pallas_kernels import hash_segagg  # BAD: relative from-import
+from . import pallas_kernels as pk2  # BAD: relative module import
+from ..exec.pallas_kernels import fused_gather as fg  # BAD: parent-relative
+# a suppressed occurrence is NOT a finding
+from igloo_tpu.exec.pallas_kernels import fused_gather  # lint: allow(pallas-dispatch) fixture
+
+
+def run(x):
+    return (pk.fused_gather([x], x, 8, True), hash_probe_bounds,
+            fused_gather, hash_segagg, pk2, fg)

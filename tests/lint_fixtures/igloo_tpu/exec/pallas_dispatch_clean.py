@@ -1,0 +1,13 @@
+"""pallas-dispatch clean twin: kernel access through the dispatch layer
+only — the flag, eligibility checks, and fallback ladder stay in force."""
+from igloo_tpu.exec import dispatch
+
+
+def probe(plan, sorted_hash, probe_hash):
+    if plan is None:
+        return None  # sort-path fallback handled by the caller
+    return dispatch.probe_bounds(plan, sorted_hash, probe_hash)
+
+
+def gather(arrays, idx):
+    return dispatch.gather_columns(arrays, idx)

@@ -1,0 +1,328 @@
+"""Pallas TPU kernels (exec/pallas_kernels.py behind exec/dispatch.py):
+interpret-mode equivalence vs the sort path on CPU, the dispatch flag
+matrix, overflow -> exact-re-run fallback, cache-key stability, and the
+pallas.* counters. Everything runs the Pallas INTERPRETER on tiny canonical
+shapes — seconds, no hardware (tier-1 budget: suite ~550s of 870s)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+import jax
+import jax.numpy as jnp
+
+from igloo_tpu.exec import dispatch
+from igloo_tpu.exec.join import _probe_bounds
+from igloo_tpu.utils import tracing
+
+
+def _interpret(monkeypatch):
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "interpret")
+
+
+def _engine(*tables):
+    from igloo_tpu.engine import QueryEngine
+    e = QueryEngine()
+    for name, t in tables:
+        e.register_table(name, t)
+    return e
+
+
+def _rows(t: pa.Table):
+    def norm(v):
+        return round(v, 9) if isinstance(v, float) else v
+    cols = [[None if v is None else norm(v) for v in c]
+            for c in t.to_pydict().values()]
+    return sorted(zip(*cols), key=lambda r: tuple((x is None, x) for x in r))
+
+
+# --- kernel-level equivalence ----------------------------------------------
+
+def _ref_bounds(sorted_build, probe):
+    # both paths compare hashes with the low bit dropped (the sort path's
+    # side-tag bit, join._probe_bounds); masking preserves sort order
+    sb = sorted_build & np.int64(-2)
+    p = probe & np.int64(-2)
+    lo = np.searchsorted(sb, p, side="left")
+    hi = np.searchsorted(sb, p, side="right")
+    return lo.astype(np.int32), hi.astype(np.int32)
+
+
+@pytest.mark.parametrize("seed,m,n,spread", [(0, 512, 256, 400),
+                                             (1, 256, 512, 50),
+                                             (2, 1024, 128, 100000)])
+def test_probe_bounds_matches_sort_path(monkeypatch, seed, m, n, spread):
+    """The kernel's (lower, upper) equal _probe_bounds' insertion bounds for
+    EVERY probe row — matched or not — including duplicate runs inside the
+    window and the dead-row / displaced-NULL sentinel runs."""
+    _interpret(monkeypatch)
+    rng = np.random.default_rng(seed)
+    live_m = m // 2
+    bk = np.concatenate([
+        rng.integers(-spread, spread, live_m - live_m // 4),
+        np.full(live_m // 4, 0x0FEDCBA987654321),       # displaced-NULL run
+        np.full(m - live_m, np.iinfo(np.int64).max),    # dead-row run
+    ]).astype(np.int64)
+    sh = np.sort(bk)
+    pk = rng.integers(-spread, spread, n).astype(np.int64)
+    plan = dispatch.plan_probe(m, n)
+    assert plan is not None and plan[0] == "probe"
+    lo, up, ovf = jax.jit(
+        lambda s, p: dispatch.probe_bounds(plan, s, p))(
+            jnp.asarray(sh), jnp.asarray(pk))
+    assert not bool(ovf)
+    ref_lo, ref_hi = _ref_bounds(sh, pk)
+    np.testing.assert_array_equal(np.asarray(lo), ref_lo)
+    np.testing.assert_array_equal(np.asarray(up), ref_hi)
+    # the sort path agrees with searchsorted on the same multiset
+    slo, sup = _probe_bounds(jnp.asarray(bk), jnp.asarray(pk))
+    np.testing.assert_array_equal(np.asarray(slo), ref_lo)
+    np.testing.assert_array_equal(np.asarray(sup), ref_hi)
+
+
+def test_probe_bounds_empty_build(monkeypatch):
+    """All-dead build side (every hash at the MAX sentinel): zero counts,
+    no overflow — the sentinel run never flags rows that don't match it."""
+    _interpret(monkeypatch)
+    m, n = 128, 64
+    sh = np.full(m, np.iinfo(np.int64).max, np.int64)
+    pk = np.random.default_rng(3).integers(-100, 100, n).astype(np.int64)
+    plan = dispatch.plan_probe(m, n)
+    lo, up, ovf = dispatch.probe_bounds(plan, jnp.asarray(sh),
+                                        jnp.asarray(pk))
+    assert not bool(ovf)
+    assert (np.asarray(up) - np.asarray(lo) == 0).all()
+
+
+def test_probe_overflow_flag_on_all_one_key(monkeypatch):
+    """A duplicate-hash run longer than the window raises the overflow
+    flag (all-one-key skew): the result must be discarded."""
+    _interpret(monkeypatch)
+    m, n = 256, 64
+    sh = np.zeros(m, np.int64)                    # one key, run of 256
+    pk = np.zeros(n, np.int64)
+    plan = dispatch.plan_probe(m, n)
+    _lo, _up, ovf = dispatch.probe_bounds(plan, jnp.asarray(sh),
+                                          jnp.asarray(pk))
+    assert bool(ovf)
+
+
+def test_fused_gather_matches_take(monkeypatch):
+    """The fused multi-column gather equals one jnp.take per lane across
+    dtypes (int64, float64, bool null lanes), under jit."""
+    _interpret(monkeypatch)
+    rng = np.random.default_rng(4)
+    m, n = 512, 256
+    cols = [jnp.asarray(rng.integers(-5, 5, m).astype(np.int64)),
+            jnp.asarray(rng.normal(size=m)),
+            jnp.asarray(rng.random(m) < 0.3)]
+    idx = jnp.asarray(rng.integers(0, m, n).astype(np.int32))
+    with tracing.counter_delta() as d:
+        outs = jax.jit(lambda c, i: dispatch.gather_columns(c, i))(cols, idx)
+    assert d.get("pallas.gather") > 0
+    for c, o in zip(cols, outs):
+        np.testing.assert_array_equal(np.asarray(jnp.take(c, idx)),
+                                      np.asarray(o))
+
+
+def test_segagg_overflow_on_exhausted_bucket(monkeypatch):
+    """More distinct keys than one bucket's ways -> overflow flag (the
+    kernel must never silently merge or drop groups)."""
+    _interpret(monkeypatch)
+    n = 128
+    packed = jnp.asarray(np.arange(n, dtype=np.int64))
+    live = jnp.ones((n,), bool)
+    plan = ("segagg", 1, 8, 64, True)  # ONE bucket, 8 ways, 128 keys
+    _k, _c, _t, ovf = dispatch.segagg(plan, packed, live, ("count",), [live])
+    assert bool(ovf)
+
+
+# --- dispatch flag matrix ---------------------------------------------------
+
+def test_dispatch_flag_matrix(monkeypatch):
+    """0 -> off; auto -> off on CPU (TPU-only); 1 -> on, interpreted on
+    CPU; interpret -> on + interpreted everywhere."""
+    cases = {"0": (False, False), "auto": (False, False),
+             "1": (True, True), "interpret": (True, True)}
+    for mode, want in cases.items():
+        monkeypatch.setenv("IGLOO_TPU_PALLAS", mode)
+        assert dispatch.kernel_state() == want, mode
+        if not want[0]:
+            assert dispatch.plan_probe(1024, 1024) is None
+            assert dispatch.plan_segagg(None, 1, 1024) is None
+    monkeypatch.delenv("IGLOO_TPU_PALLAS", raising=False)
+    assert dispatch.mode() == "auto"
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "garbage")
+    assert dispatch.mode() == "auto"
+
+
+def test_plan_eligibility_fallbacks(monkeypatch):
+    _interpret(monkeypatch)
+    with tracing.counter_delta() as d:
+        assert dispatch.plan_probe(1024, 1024, banned=True) is None
+        assert dispatch.plan_probe(dispatch.PROBE_MAX_BUILD * 2, 1024) is None
+        assert dispatch.plan_segagg(None, 2, 1024) is None  # no pack
+    assert d.get("pallas.fallback.banned") == 1
+    assert d.get("pallas.fallback.too_big") == 1
+    assert d.get("pallas.fallback.unpackable") == 1
+
+
+def test_block_shapes_from_capacity_family(monkeypatch):
+    """Kernel block/table shapes quantize through the same pow2 family as
+    engine capacities, so kernel programs share the compile-cache keys."""
+    _interpret(monkeypatch)
+    p1 = dispatch.plan_probe(1 << 12, 1 << 14)
+    p2 = dispatch.plan_probe(1 << 12, 1 << 14)
+    assert p1 == p2
+    _, nbuckets, _w, block, _i = p1
+    assert nbuckets & (nbuckets - 1) == 0 and block & (block - 1) == 0
+    s1 = dispatch.plan_segagg((("i64", 0, ((8, True, True),)), (0,)),
+                              1, 1 << 12)
+    assert dispatch.segagg_table_rows(s1) & \
+        (dispatch.segagg_table_rows(s1) - 1) == 0
+
+
+# --- engine-level equivalence ----------------------------------------------
+
+def _join_tables(seed=7, n=600, nname=400, dup=1):
+    rng = np.random.default_rng(seed)
+    names = [f"n{i:04d}" for i in range(nname)]
+    left = pa.table({
+        "lk": pa.array(rng.choice(names, 300).tolist()),
+        "lv": pa.array(rng.integers(0, 50, 300), type=pa.int64()),
+    })
+    pool = names + [None]
+    right = pa.table({
+        "rk": pa.array((rng.choice(pool, n).tolist() * dup)[: n * dup]),
+        "rv": pa.array(rng.integers(0, 99, n * dup), type=pa.int64()),
+    })
+    return ("l", left), ("r", right)
+
+
+_JOIN_SQL = "SELECT lv, rv FROM l JOIN r ON lk = rk"
+_AGG_SQL = ("SELECT a, b, SUM(x), COUNT(*), MIN(x), MAX(b), AVG(x) "
+            "FROM t GROUP BY a, b")
+
+
+def _agg_table(seed=8, n=1000):
+    rng = np.random.default_rng(seed)
+    return ("t", pa.table({
+        "a": pa.array(rng.integers(0, 300, n), type=pa.int64()),
+        "b": pa.array([None if v < 40 else int(v)
+                       for v in rng.integers(0, 500, n)], type=pa.int64()),
+        "x": pa.array(rng.normal(size=n)),
+    }))
+
+
+def test_join_probe_adopted_and_equivalent(monkeypatch):
+    """String-key join (sorted-probe path): IGLOO_TPU_PALLAS=interpret
+    adopts the hash-probe kernel and returns exactly the sort path's rows;
+    null probe keys and unmatched rows included."""
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    base = _engine(*_join_tables()).execute(_JOIN_SQL)
+    _interpret(monkeypatch)
+    with tracing.counter_delta() as d:
+        got = _engine(*_join_tables()).execute(_JOIN_SQL)
+    assert d.get("pallas.probe") > 0
+    assert d.get("pallas.probe_overflow") == 0
+    assert _rows(got) == _rows(base)
+
+
+def test_agg_segagg_adopted_and_equivalent(monkeypatch):
+    """Two int keys whose radix product exceeds the direct-scatter bound
+    (sort tier today): the hash-agg kernel adopts and matches the sort path
+    (ints/counts exactly; float sums to accumulation-order tolerance)."""
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    base = _engine(_agg_table()).execute(_AGG_SQL)
+    _interpret(monkeypatch)
+    with tracing.counter_delta() as d:
+        got = _engine(_agg_table()).execute(_AGG_SQL)
+    assert d.get("pallas.segagg") > 0
+    assert d.get("pallas.agg_overflow") == 0
+    assert _rows(got) == _rows(base)
+
+
+def test_probe_overflow_falls_back_exactly(monkeypatch):
+    """All-one-key skew on the build side: the probe window overflows, the
+    deferred flag discards the result, the exact sort path re-runs, and the
+    join is negative-cached (second execution doesn't re-attempt)."""
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    tabs = _join_tables(seed=9, nname=4)   # 4 names over 600 rows: runs ~150
+    base = _engine(*tabs).execute(_JOIN_SQL)
+    _interpret(monkeypatch)
+    e = _engine(*tabs)
+    with tracing.counter_delta() as d:
+        got = e.execute(_JOIN_SQL)
+    assert d.get("pallas.probe_overflow") >= 1
+    assert _rows(got) == _rows(base)
+    e.result_cache.clear()
+    with tracing.counter_delta() as d2:
+        again = e.execute(_JOIN_SQL)
+    assert d2.get("pallas.probe_overflow") == 0       # banned, not retried
+    assert d2.get("pallas.fallback.banned") >= 1
+    assert _rows(again) == _rows(base)
+
+
+def test_compile_failure_falls_back_exactly(monkeypatch):
+    """The compile-failure rung: a Pallas program the backend cannot lower
+    (simulated by making the dispatch wrapper raise at trace time) is
+    negative-cached and the query re-runs on the sort path — correct
+    results, attributable counter, no error to the caller."""
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    base = _engine(*_join_tables()).execute(_JOIN_SQL)
+    _interpret(monkeypatch)
+    from igloo_tpu.exec import dispatch as dispatch_mod
+
+    def boom(plan, sorted_hash, probe_hash):
+        raise RuntimeError("mosaic cannot lower this")
+    monkeypatch.setattr(dispatch_mod, "probe_bounds", boom)
+    import igloo_tpu.exec.join as join_mod
+    monkeypatch.setattr(join_mod.dispatch, "probe_bounds", boom)
+    with tracing.counter_delta() as d:
+        got = _engine(*_join_tables()).execute(_JOIN_SQL)
+    assert d.get("pallas.compile_fallback") >= 1
+    assert _rows(got) == _rows(base)
+
+
+def test_pallas_zero_reproduces_sort_path(monkeypatch):
+    """IGLOO_TPU_PALLAS=0: no pallas counters at all; plans/results are the
+    sort path's bit for bit."""
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    with tracing.counter_delta() as d:
+        r0 = _engine(*_join_tables()).execute(_JOIN_SQL)
+        a0 = _engine(_agg_table()).execute(_AGG_SQL)
+    assert not any(k.startswith("pallas") for k, v in d.values().items()
+                   if v)
+    monkeypatch.delenv("IGLOO_TPU_PALLAS", raising=False)  # auto == off (CPU)
+    with tracing.counter_delta() as d2:
+        r1 = _engine(*_join_tables()).execute(_JOIN_SQL)
+        a1 = _engine(_agg_table()).execute(_AGG_SQL)
+    assert not any(k.startswith("pallas") for k, v in d2.values().items()
+                   if v)
+    assert _rows(r0) == _rows(r1) and _rows(a0) == _rows(a1)
+
+
+def test_cache_key_stability_one_compile(monkeypatch):
+    """Same canonical shape -> one compile: after the first execution, warm
+    re-runs of the same query under the Pallas path hit the jit cache."""
+    _interpret(monkeypatch)
+    e = _engine(*_join_tables())
+    e.execute(_JOIN_SQL)
+    e.result_cache.clear()
+    e.execute(_JOIN_SQL)          # hint-adoption round, may recompile
+    e.result_cache.clear()
+    with tracing.counter_delta() as d:
+        e.execute(_JOIN_SQL)
+    assert d.get("jit.miss") == 0
+
+
+def test_explain_analyze_records_kernel_choice(monkeypatch):
+    """EXPLAIN ANALYZE (staged detail mode) carries the dispatch decision as
+    an operator attribute and in the rendered tree."""
+    _interpret(monkeypatch)
+    e = _engine(*_join_tables(), _agg_table())
+    res = e.query("EXPLAIN ANALYZE " + _JOIN_SQL)
+    joins = res.stats.find_ops("Join")
+    assert joins and joins[0].attrs.get("pallas") == "probe"
+    res2 = e.query("EXPLAIN ANALYZE " + _AGG_SQL)
+    aggs = res2.stats.find_ops("Aggregate")
+    assert aggs and aggs[0].attrs.get("pallas") == "segagg"
+    assert aggs[0].attrs.get("strategy") == "pallas_segagg"
