@@ -244,7 +244,7 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
         for k in ("grace_partitions", "grace_pipeline", "counters",
                   "warm_h2d_bytes", "peak_hbm_bytes", "shuffle_buckets",
                   "exchange_bytes", "compile_cache_hits",
-                  "compile_cache_misses", "adaptive", "pallas"):
+                  "compile_cache_misses", "adaptive", "pallas", "topology"):
             if k in rec:
                 block["queries"][q][k] = rec[k]
         log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s "
@@ -314,6 +314,40 @@ def main() -> None:
         else:
             log(f"sf10 block skipped: {remaining():.0f}s left < {need}s")
             detail["sf10"] = {"skipped": f"budget ({remaining():.0f}s left)"}
+
+    # chips x hosts scaling curve (docs/distributed.md "Two-level topology"):
+    # a small distributed join at 1x1 / 1x2 / 2x1 / 2x2 (workers x virtual
+    # devices per worker), so BENCH_DETAIL records how the fragment exchange
+    # and the in-worker mesh tier compose. Runs as a subprocess (it spawns
+    # its own worker processes with different XLA device counts) and is
+    # budget-gated like the SF10 block.
+    if os.environ.get("BENCH_TWOLEVEL", "1") == "1":
+        if remaining() > 180:
+            # own process GROUP: a timeout must kill the smoke's worker
+            # subprocesses too, not orphan them into the rest of the bench
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "scripts", "twolevel_smoke.py"),
+                 "--scaling", "--json"],
+                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                start_new_session=True)
+            try:
+                out, _err = proc.communicate(timeout=remaining() - 30)
+                line = out.decode().strip().splitlines()[-1]
+                detail["twolevel_scaling"] = json.loads(line)
+                log("bench: twolevel scaling block recorded")
+            except Exception as e:
+                try:
+                    os.killpg(proc.pid, 9)
+                except OSError:
+                    pass
+                proc.wait()
+                log(f"twolevel scaling FAILED: {type(e).__name__}: {e}")
+                detail["twolevel_scaling"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+        else:
+            detail["twolevel_scaling"] = {
+                "skipped": f"budget ({remaining():.0f}s left)"}
 
     def gmean(xs):
         return math.exp(sum(math.log(x) for x in xs) / len(xs)) if xs else 0.0

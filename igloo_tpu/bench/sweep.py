@@ -54,7 +54,8 @@ _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 # not just detected
 _DELTA_PREFIXES = ("jit.", "pack.", "grace.", "chunked.", "xfer.",
                    "cache.", "result_cache.", "engine.", "fused.", "join.",
-                   "exchange.", "compile_cache.", "adaptive.", "pallas.")
+                   "exchange.", "compile_cache.", "adaptive.", "pallas.",
+                   "mesh.")
 
 # Pallas kernel names whose dispatch counters feed the per-query `pallas`
 # block (docs/kernels.md); fallback/overflow counters are summed beside
@@ -169,6 +170,23 @@ def run_query(engine, sql: str, trials: int) -> dict:
         "kernels_used": [k for k in _PALLAS_KERNELS
                          if query_delta.get(f"pallas.{k}") > 0],
         "fallbacks": fallbacks,
+    }
+    # two-level topology block (docs/distributed.md): which level(s) of
+    # parallelism this query's execution actually used. A sweep worker is one
+    # process (one "host"); mesh_devices counts its chip-level shards, and
+    # `sharded` says the sharded tier ran: the mesh resolved AND no other
+    # tier (host / chunked / GRACE) took the query instead. NOT keyed on the
+    # upload counters — a warm query serves row-sharded batches from the
+    # scan cache with zero uploads in its delta. The chips x hosts scaling
+    # curve lands beside this in BENCH_DETAIL.json ("twolevel_scaling").
+    mesh = engine._resolve_mesh() if hasattr(engine, "_resolve_mesh") else None
+    routed_elsewhere = any(
+        query_delta.get(k) > 0 for k in
+        ("engine.host_route", "engine.chunked_route", "engine.grace_route"))
+    rec["topology"] = {
+        "workers": 1,
+        "mesh_devices": int(mesh.devices.size) if mesh is not None else 1,
+        "sharded": mesh is not None and not routed_elsewhere,
     }
     joins = query_delta.get("grace.join")
     rec["grace"] = query_delta.get("engine.grace_route") > 0

@@ -104,6 +104,13 @@ class WorkerState:
     addr: str
     last_seen: float
     tables_pushed: set = field(default_factory=set)
+    # topology reported at registration/heartbeat (cluster/serde.py
+    # worker_info_*): size of the worker's LOCAL mesh — the chips one
+    # fragment runs across — and its execution-slot bound. The planner sizes
+    # bucket counts with hosts and weights bucket placement with these
+    # (docs/distributed.md "Two-level topology").
+    devices: int = 1
+    slots: int = 0
 
 
 class Membership:
@@ -115,14 +122,23 @@ class Membership:
         self._workers: dict[str, WorkerState] = {}
         self._lock = threading.Lock()
 
-    def register(self, worker_id: str, addr: str) -> None:
+    def register(self, worker_id: str, addr: str, devices: int = 1,
+                 slots: int = 0) -> None:
         with self._lock:
-            self._workers[worker_id] = WorkerState(worker_id, addr, time.time())
+            self._workers[worker_id] = WorkerState(
+                worker_id, addr, time.time(),
+                devices=max(int(devices), 1), slots=int(slots))
         tracing.counter("coordinator.workers_registered")
 
-    def heartbeat(self, worker_id: str, addr: str = "") -> bool:
+    def heartbeat(self, worker_id: str, addr: str = "",
+                  devices: Optional[int] = None,
+                  slots: Optional[int] = None) -> bool:
         """True if known (reference answers ok=false for unknown workers —
-        the worker should re-register)."""
+        the worker should re-register). `devices`/`slots` refresh the
+        topology so a worker whose visible device count or slot bound
+        changed (restart behind the same id, hotplugged slice, retuned
+        IGLOO_WORKER_SLOTS) is re-planned against reality, not its
+        registration-time snapshot."""
         with self._lock:
             w = self._workers.get(worker_id)
             if w is None:
@@ -130,7 +146,16 @@ class Membership:
             w.last_seen = time.time()
             if addr:
                 w.addr = addr
+            if devices:
+                w.devices = max(int(devices), 1)
+            if slots:
+                w.slots = int(slots)
             return True
+
+    def topology(self) -> dict:
+        """addr -> local mesh device count for every live worker."""
+        with self._lock:
+            return {w.addr: w.devices for w in self._workers.values()}
 
     def evict(self, worker_id: str) -> None:
         with self._lock:
@@ -916,7 +941,12 @@ class CoordinatorServer(flight.FlightServerBase):
         if not live or not self._distributable(plan):
             # only distribute plans whose base tables every worker resolves
             return self._run_local(sql, stream, deadline, t_start, permit)
-        planner = DistributedPlanner([w.addr for w in live])
+        # per-worker device counts ride into planning: bucket counts scale
+        # with hosts, per-worker shard counts with chips, and heterogeneous
+        # clusters get device-weighted bucket placement (two-level
+        # parallelism, docs/distributed.md)
+        topo = {w.addr: w.devices for w in live}
+        planner = DistributedPlanner([w.addr for w in live], topology=topo)
         frags = planner.plan(plan)
         tracing.counter("coordinator.distributed_queries")
         # reorder decisions from engine.plan's optimize() above ride beside
@@ -924,7 +954,12 @@ class CoordinatorServer(flight.FlightServerBase):
         from igloo_tpu.plan.optimizer import last_adaptive_decisions
         adaptive_info = last_adaptive_decisions() + planner.adaptive_info
         extra = {"queue_wait_s": round(permit.wait_s, 6),
-                 "priority": permit.priority, "demoted": 0}
+                 "priority": permit.priority, "demoted": 0,
+                 # the topology this query was planned against, published in
+                 # last_metrics beside the per-fragment mesh_devices reports
+                 "topology": {"workers": len(live),
+                              "devices": topo,
+                              "total_shards": sum(topo.values())}}
         try:
             if stream:
                 schema, gen = self.executor.execute_stream(
@@ -1103,7 +1138,10 @@ class CoordinatorServer(flight.FlightServerBase):
             return [json.dumps(
                 {"queries": self.executor.active_queries()}).encode()]
         if action.type == "register_worker":
-            self.membership.register(req["id"], req["addr"])
+            info = serde.worker_info_from_json(req)
+            self.membership.register(info["id"], info["addr"],
+                                     devices=info["devices"],
+                                     slots=info["slots"])
             w = self.membership.by_addr(req["addr"])
             if w is not None:
                 try:
@@ -1136,7 +1174,13 @@ class CoordinatorServer(flight.FlightServerBase):
                 compile_cache.decode_entry(req.get("data", "")))
             return [json.dumps({"stored": stored}).encode()]
         if action.type == "heartbeat":
-            ok = self.membership.heartbeat(req["id"], req.get("addr", ""))
+            info = serde.worker_info_from_json(req)
+            # a legacy payload WITHOUT the topology fields must not reset
+            # the recorded devices to the codec's default of 1
+            ok = self.membership.heartbeat(
+                info["id"], info["addr"],
+                devices=info["devices"] if "devices" in req else None,
+                slots=info["slots"])
             return [json.dumps({"ok": ok}).encode()]
         if action.type == "register_table":
             provider = serde.provider_from_spec(req["spec"])
@@ -1145,7 +1189,8 @@ class CoordinatorServer(flight.FlightServerBase):
         if action.type == "cluster_status":
             return [json.dumps({
                 "workers": [{"id": w.worker_id, "addr": w.addr,
-                             "last_seen": w.last_seen}
+                             "last_seen": w.last_seen,
+                             "devices": w.devices, "slots": w.slots}
                             for w in self.membership.live()],
                 "tables": sorted(self.engine.catalog.names()),
             }).encode()]
@@ -1157,8 +1202,11 @@ class CoordinatorServer(flight.FlightServerBase):
         if action.type == "metrics":
             # coordinator process registry + worker-aggregated fragment
             # stats, Prometheus text (raw bytes — rpc.flight_action_raw)
+            live_w = self.membership.live()
             extra = ["# TYPE igloo_workers_live gauge",
-                     f"igloo_workers_live {len(self.membership.live())}"]
+                     f"igloo_workers_live {len(live_w)}",
+                     "# TYPE igloo_cluster_devices gauge",
+                     f"igloo_cluster_devices {sum(w.devices for w in live_w)}"]
             extra.extend(self.executor.prometheus_lines())
             return [tracing.prometheus_text(extra_lines=extra).encode()]
         if action.type == "ping":

@@ -188,11 +188,24 @@ class DistributedPlanner:
     exchange shape, so behavior only changes once telemetry justifies it."""
 
     def __init__(self, workers: list[str], partitions_per_worker: int = 1,
-                 shuffle_buckets: Optional[int] = None):
+                 shuffle_buckets: Optional[int] = None,
+                 topology: Optional[dict] = None):
         if not workers:
             raise ValueError("no workers")
         self.workers = list(workers)
         self.ppw = partitions_per_worker
+        # addr -> local mesh device count, from registration/heartbeat
+        # reports (cluster/serde.py worker_info_*). Two-level sizing rule:
+        # BUCKET COUNT scales with hosts (workers x ppw below — a bucket is
+        # a unit of cross-worker exchange), SHARD COUNT scales with chips
+        # (each bucket fragment row-shards across its worker's mesh), so a
+        # B-bucket join on W workers x D devices runs W x D-way without the
+        # planner over-bucketing to W x D fragments (which would multiply
+        # exchange slices and per-fragment overhead, not parallelism).
+        self.topology = {a: max(int(d), 1)
+                         for a, d in (topology or {}).items()}
+        self.total_shards = sum(self.topology.get(a, 1)
+                                for a in self.workers)
         self._rr = itertools.cycle(range(len(workers)))
         if shuffle_buckets is None:
             env = os.environ.get("IGLOO_SHUFFLE_BUCKETS")
@@ -219,6 +232,31 @@ class DistributedPlanner:
 
     def _next_worker(self) -> str:
         return self.workers[next(self._rr)]
+
+    def _bucket_placement(self, n_buckets: int) -> list[str]:
+        """Bucket -> worker assignment. Homogeneous topologies keep the
+        round-robin stride; a heterogeneous cluster (workers with unequal
+        mesh sizes) gets largest-remainder proportional shares — a 4-chip
+        worker takes 4x the buckets of a 1-chip worker, since each of its
+        buckets runs 4-way inside the mesh — interleaved so consecutive
+        buckets still spread across workers."""
+        W = len(self.workers)
+        devs = [self.topology.get(a, 1) for a in self.workers]
+        if len(set(devs)) <= 1:
+            return [self.workers[b % W] for b in range(n_buckets)]
+        total = sum(devs)
+        quota = [n_buckets * d / total for d in devs]
+        counts = [int(q) for q in quota]
+        for i in sorted(range(W), key=lambda i: quota[i] - counts[i],
+                        reverse=True)[:n_buckets - sum(counts)]:
+            counts[i] += 1
+        out: list[str] = []
+        while len(out) < n_buckets:
+            for i in range(W):
+                if counts[i]:
+                    counts[i] -= 1
+                    out.append(self.workers[i])
+        return out
 
     def _make_fragment(self, plan: L.LogicalPlan,
                        frags_out: list[QueryFragment],
@@ -319,6 +357,7 @@ class DistributedPlanner:
                                                stats_key=rkey, salt=rsalt)
         join_scans: list[L.LogicalPlan] = []
         W = len(self.workers)
+        placement = self._bucket_placement(B)
         for b in range(B_total):
             jb = L.Join(left=_bucket_union(left_frags, b, B_total,
                                            p.left.schema),
@@ -331,17 +370,23 @@ class DistributedPlanner:
             jb.schema = p.schema
             if salt is not None and b >= B:
                 # salted extra buckets hold slices of the HOT bucket's work:
-                # rotate them onto workers AFTER the hot bucket's own, or
-                # the split re-serializes on one worker
-                worker = self.workers[(salt[0] + 1 + (b - B)) % W]
+                # rotate them onto workers AFTER the one the hot bucket was
+                # PLACED on (the weighted placement, not the bucket index —
+                # a heterogeneous placement can put bucket `hot` anywhere),
+                # or the split re-serializes on one worker (host-rotated,
+                # not device-weighted: they are slices of ONE bucket, and
+                # spreading across hosts is the whole point)
+                hot_i = self.workers.index(placement[salt[0]])
+                worker = self.workers[(hot_i + 1 + (b - B)) % W]
             else:
-                worker = self.workers[b % W]
+                worker = placement[b]
             jf = self._make_fragment(jb, frags, worker=worker,
                                      kind="join", bucket=b)
             join_scans.append(_frag_scan(jf))
         if salt is None and self.adaptive_enabled:
             self.adaptive_info.append({
                 "strategy": "shuffle", "buckets": B,
+                "total_shards": self.total_shards,
                 "adaptive_source": "observed" if (lobs or robs)
                 else "estimated"})
         if len(join_scans) == 1:
@@ -409,7 +454,18 @@ class DistributedPlanner:
         """"left"/"right" build side to replicate, or None. Fires only on
         OBSERVED sizes: replicating on a bad estimate ships build x W bytes,
         while a missed broadcast merely keeps the exchange — asymmetric risk,
-        so the first run always observes."""
+        so the first run always observes.
+
+        Two-level composition: this rule decides HOST-level replication
+        (W - 1 extra network copies), independently of the mesh tier's
+        `should_broadcast` (parallel/shuffle.py), which decides CHIP-level
+        distribution of whatever one worker holds. They cannot
+        double-broadcast: a side replicated here arrives on each worker
+        once, and the worker's mesh then either all-gathers that one copy
+        across its chips (chip broadcast) or hash-shuffles it (chip
+        exchange) — each level moves only its own minimum, and salting
+        stays a fragment-level concern (the mesh tier's escape hatch is
+        broadcast, see PATHOLOGICAL SKEW RULE)."""
         if not self.adaptive_enabled:
             return None
         lb = self._obs_bytes(p.left, lobs)

@@ -301,6 +301,32 @@ def _rx(j, catalog) -> Optional[E.Expr]:
     return e
 
 
+# --- worker info (registration / heartbeat payloads) ---
+
+
+def worker_info_to_json(worker_id: str, addr: str, devices: int = 1,
+                        slots: int = 0, ts: Optional[float] = None) -> dict:
+    """The registration/heartbeat payload, in ONE place for both sides of the
+    wire: `devices` is the size of the worker's LOCAL mesh (1 = single-device)
+    — the topology number the distributed planner sizes bucket counts and
+    placement with (bucket count scales with hosts, shard count with chips,
+    docs/distributed.md) — and `slots` its execution-slot bound."""
+    d = {"id": worker_id, "addr": addr, "devices": int(max(devices, 1)),
+         "slots": int(slots)}
+    if ts is not None:
+        d["ts"] = ts
+    return d
+
+
+def worker_info_from_json(d: dict) -> dict:
+    """Decode with version tolerance: a worker predating the topology fields
+    (or a hand-rolled client) registers as single-device, which keeps the
+    planner's sizing exactly as it was before two-level parallelism."""
+    return {"id": d["id"], "addr": d.get("addr", ""),
+            "devices": int(d.get("devices", 1) or 1),
+            "slots": int(d.get("slots", 0) or 0)}
+
+
 # --- provider specs (how a worker re-creates a coordinator table) ---
 
 

@@ -60,18 +60,39 @@ _GUARDED_BY = {"_lock": ("_mesh", "_mesh_setting")}
 
 #: worker-side fragment-execution slot bound: Flight runs every RPC on its
 #: own thread, so without this two concurrent execute_fragment actions race
-#: each other into device OOM. Default = a small multiple of the local
-#: device count (fragments on one device mostly serialize on it anyway;
-#: a little oversubscription overlaps host-side decode with device work).
+#: each other into device OOM. Default = a small multiple of the number of
+#: INDEPENDENT execution units the worker has: local devices for a
+#: single-device worker (fragments on one device mostly serialize on it
+#: anyway; a little oversubscription overlaps host-side decode with device
+#: work), but local_devices / mesh_devices for a MESH worker — a sharded
+#: fragment occupies every chip of the mesh at once, so 2 x device_count
+#: slots would admit 16 whole-mesh fragments against HBM sized for ~2 and
+#: invalidate the coordinator's per-host HBM predictions (docs/serving.md).
 WORKER_SLOTS_ENV = "IGLOO_WORKER_SLOTS"
 
 
-def _default_slots() -> int:
+def _default_slots(mesh_devices: int = 1) -> int:
     try:
         import jax
-        return max(2, 2 * jax.local_device_count())
+        local = jax.local_device_count()
     except Exception:
         return 2
+    units = max(1, local // max(mesh_devices, 1))
+    return max(2, 2 * units)
+
+
+def _plan_wants_mesh(plan) -> bool:
+    """True when a fragment's plan carries a blocking operator the LOCAL mesh
+    tier accelerates (join / aggregate / set op / distinct / window / sort):
+    those route through the ShardedExecutor so the fragment runs D-way across
+    the worker's chips — the inner level of the two-level parallelism
+    (docs/distributed.md). Scan/filter/project (and Exchange-rooted partition)
+    fragments stay single-device: their output is gathered host-side for the
+    store anyway, and the sharded tier's padded per-device capacities only
+    add upload overhead there."""
+    return any(isinstance(n, (L.Join, L.Aggregate, L.SetOpJoin, L.Distinct,
+                              L.Window, L.Sort))
+               for n in L.walk_plan(plan))
 
 
 def _dep_key(frag_id: str, bucket) -> str:
@@ -128,6 +149,13 @@ class WorkerServer(flight.FlightServerBase):
         self._lock = threading.Lock()
         self._mesh_setting = mesh  # same rule as QueryEngine (resolve_mesh)
         self._mesh = None
+        # devices one fragment will occupy (the LOCAL mesh tier): reported to
+        # the coordinator at registration/heartbeat so the planner sizes
+        # bucket counts with hosts and shard counts with chips
+        # (docs/distributed.md "Two-level topology"). Computed once from the
+        # SETTING — the lazily resolved mesh spans the same devices.
+        from igloo_tpu.parallel.mesh import mesh_device_count
+        self.mesh_devices = mesh_device_count(mesh)
         from igloo_tpu.exec.cache import BatchCache
         self._batch_cache = BatchCache(1 << 30)
         # fragment-execution slot bound (env > constructor > device-derived
@@ -137,13 +165,13 @@ class WorkerServer(flight.FlightServerBase):
         if env:
             slots = int(env)
         if slots is None:
-            slots = _default_slots()
+            slots = _default_slots(self.mesh_devices)
         self.slots = max(1, slots)
         self._slots = threading.BoundedSemaphore(self.slots)
 
     # --- execution ---
 
-    def _executor(self):
+    def _executor(self, plan=None):
         # multi-chip worker hosts row-shard fragments across their local
         # devices; same mesh-resolution rule as QueryEngine (so tests pin
         # DEFAULT_MESH and production configures via the constructor).
@@ -157,7 +185,7 @@ class WorkerServer(flight.FlightServerBase):
                 if self._mesh is None:
                     self._mesh_setting = None
             mesh = self._mesh
-        if mesh is not None:
+        if mesh is not None and (plan is None or _plan_wants_mesh(plan)):
             from igloo_tpu.parallel.executor import ShardedExecutor
             return ShardedExecutor(self._jit_cache, use_jit=self._use_jit,
                                    batch_cache=self._batch_cache,
@@ -242,15 +270,25 @@ class WorkerServer(flight.FlightServerBase):
                     salt = (plan.salt_bucket, plan.salt, plan.salt_role)
                 plan = plan.input
             t0 = time.perf_counter()
-            table = self._executor().execute_to_arrow(plan)
+            ex = self._executor(plan)
+            table = ex.execute_to_arrow(plan)
             elapsed = time.perf_counter() - t0
             ent = self._store.put(frag_id, table, partition=partition,
                                   salt=salt)
         tracing.counter("worker.fragments")
+        # local mesh-tier attribution: how many chips this fragment ran
+        # across (1 = single-device) and its result rows per chip — the
+        # per-fragment numbers last_metrics / EXPLAIN ANALYZE surface so the
+        # two-level W x D parallelism is verifiable, not assumed
+        mesh_devices = int(getattr(ex, "n_dev", 1))
+        if mesh_devices > 1:
+            tracing.counter("mesh.sharded_fragments")
         out = {"id": frag_id, "rows": table.num_rows,
                "elapsed_s": round(elapsed, 6), "worker": self.worker_id,
                "dep_fetch_s": round(dep_s, 6),
                "input_rows": input_rows,
+               "mesh_devices": mesh_devices,
+               "mesh_rows_per_device": table.num_rows // mesh_devices,
                # Arrow bytes of the stored result: the coordinator's
                # adaptive recording sums these per join side
                "result_bytes": ent.nbytes,
@@ -316,7 +354,8 @@ class WorkerServer(flight.FlightServerBase):
             return [json.dumps({"worker": self.worker_id,
                                 "tables": sorted(self._catalog.names()),
                                 "fragments": len(own),
-                                "slots": self.slots}).encode()]
+                                "slots": self.slots,
+                                "mesh_devices": self.mesh_devices}).encode()]
         if action.type == "metrics":
             # Prometheus text exposition of this worker process's registry
             # (raw bytes, not JSON — scrape via rpc.flight_action_raw)
@@ -422,8 +461,10 @@ class Worker:
                 # documented register_timeout_s
                 resp = self._coordinator_action(
                     "register_worker",
-                    {"id": self.server.worker_id,
-                     "addr": self.server.advertise},
+                    serde.worker_info_to_json(
+                        self.server.worker_id, self.server.advertise,
+                        devices=self.server.mesh_devices,
+                        slots=self.server.slots),
                     deadline=deadline)
                 break
             except Exception as ex:
@@ -573,10 +614,12 @@ class Worker:
         import sys
         while not self._stop.wait(self.heartbeat_interval_s):
             try:
-                resp = self._coordinator_action("heartbeat", {
-                    "id": self.server.worker_id,
-                    "addr": self.server.advertise,
-                    "ts": time.time()})
+                resp = self._coordinator_action(
+                    "heartbeat",
+                    serde.worker_info_to_json(
+                        self.server.worker_id, self.server.advertise,
+                        devices=self.server.mesh_devices,
+                        slots=self.server.slots, ts=time.time()))
                 if not resp.get("ok", True):
                     self._register()
                     tracing.counter("worker.reregistrations")

@@ -218,6 +218,27 @@ class QueryEngine:
                 if cc_hit or cc_miss:
                     text += (f"\n-- compile_cache: hits={cc_hit} "
                              f"misses={cc_miss}")
+                # local mesh-tier attribution: did the sharded executor run,
+                # across how many chips, at what per-device lane width (the
+                # chip-level half of the two-level topology,
+                # docs/distributed.md). Keyed on the TIER, not the upload
+                # counters: a warm run serves row-sharded batches from the
+                # scan cache (zero uploads) but still executes sharded
+                uploads = delta.get("mesh.shard_uploads", 0)
+                if uploads or qs.tier == "sharded":
+                    mesh = self._resolve_mesh()
+                    ndev = int(mesh.devices.size) if mesh is not None else 1
+                    lanes = delta.get("mesh.sharded_lanes", 0)
+                    text += (f"\n-- mesh: devices={ndev} "
+                             f"shard_uploads={uploads}")
+                    # lane width only when this query actually uploaded —
+                    # a warm run's batches come from the scan cache and a
+                    # zero-delta division would claim 0 lanes per device
+                    if uploads:
+                        text += (f" lanes_per_device="
+                                 f"{lanes // uploads // max(ndev, 1)}")
+                    else:
+                        text += " (batches served from the scan cache)"
             return QueryResult(pa.table({"plan": text.split("\n")}), plan=plan,
                                elapsed_s=time.perf_counter() - t0, stats=qs)
         if isinstance(stmt, A.CreateTableAsStmt):

@@ -21,8 +21,23 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn, MIN_CAPACITY
+from igloo_tpu.utils import tracing
 
 ROWS = "rows"  # the one mesh axis: row-partitioned data parallelism
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """Version-tolerant `shard_map`: `jax.shard_map` where it exists (JAX >=
+    0.6), else `jax.experimental.shard_map.shard_map` — whose equivalent of
+    `check_vma` is spelled `check_rep`. Every mesh program in parallel/ goes
+    through this one call site, so a JAX upgrade (either direction) cannot
+    reintroduce the AttributeError class of breakage."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
 
 
 def resolve_mesh(setting) -> Optional[Mesh]:
@@ -78,9 +93,31 @@ def _put_batch(batch: DeviceBatch, sharding: NamedSharding,
 
 def shard_rows(batch: DeviceBatch, mesh: Mesh) -> DeviceBatch:
     """Reshard a batch so its lanes are row-partitioned across the mesh.
-    Capacity is padded up so every device gets at least MIN_CAPACITY lanes."""
-    n = mesh.devices.size
+    Capacity is padded up so every device gets at least MIN_CAPACITY lanes.
+    The H2D upload IS the repartition: each device receives only its row
+    slice, so no separate redistribution collective runs. `mesh.shard_uploads`
+    / `mesh.sharded_lanes` attribute the uploads per query/fragment (lanes =
+    padded capacity, known host-side without a device sync; divide by the
+    mesh size for lanes-per-device)."""
+    n = int(mesh.devices.size)
+    tracing.counter("mesh.shard_uploads")
+    # the PADDED capacity (what _put_batch actually uploads), not the
+    # incoming one — small batches resize up to n * MIN_CAPACITY first
+    tracing.counter("mesh.sharded_lanes",
+                    max(batch.capacity, n * MIN_CAPACITY))
     return _put_batch(batch, row_sharding(mesh), n * MIN_CAPACITY)
+
+
+def mesh_device_count(setting) -> int:
+    """Devices a resolved mesh setting WOULD span (1 = single-device): the
+    topology number a worker reports at registration/heartbeat and the basis
+    of its execution-slot default — a mesh fragment occupies every device of
+    the mesh at once (cluster/worker.py)."""
+    try:
+        m = resolve_mesh(setting)
+    except Exception:
+        return 1
+    return int(m.devices.size) if m is not None else 1
 
 
 def replicate(batch: DeviceBatch, mesh: Mesh) -> DeviceBatch:

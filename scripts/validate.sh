@@ -18,6 +18,9 @@ fi
 echo "== 2-worker shuffle-join smoke (fragment-tier exchange) =="
 python scripts/shuffle_smoke.py
 
+echo "== two-level smoke (2 workers x 2 devices: mesh tier inside the exchange) =="
+python scripts/twolevel_smoke.py
+
 echo "== chaos smoke (injected faults + worker kill + hung worker) =="
 python scripts/chaos_smoke.py
 
