@@ -54,13 +54,16 @@ class DistributedClient:
     def execute(self, sql: str, deadline_s: Optional[float] = None,
                 qid: Optional[str] = None, priority: Optional[int] = None,
                 session: Optional[str] = None,
-                busy_wait_s: Optional[float] = None) -> pa.Table:
+                busy_wait_s: Optional[float] = None,
+                trace_id: Optional[str] = None) -> pa.Table:
         """One round trip: the ticket IS the SQL (do_get executes once).
         `deadline_s` bounds the query server-side (and this call, slightly
         padded so the coordinator's deadline fires first and reports
         properly); `qid` names it for `cancel`; `priority` (0 = interactive
         ... lower tiers) and `session` feed the coordinator's admission
-        controller (docs/serving.md).
+        controller (docs/serving.md); `trace_id` names the query's stitched
+        flight-recorder timeline (fetch it back with the `trace` action —
+        docs/observability.md#distributed-tracing).
 
         Retry model: a SHED query (the coordinator's admission queue was
         full — `IGLOO_BUSY` marker) is retried with backoff honoring the
@@ -73,7 +76,8 @@ class DistributedClient:
         so no partial batches were consumed."""
         ticket = sql
         if deadline_s is not None or qid is not None \
-                or priority is not None or session is not None:
+                or priority is not None or session is not None \
+                or trace_id is not None:
             body: dict = {"sql": sql}
             if deadline_s is not None:
                 body["deadline_s"] = deadline_s
@@ -83,6 +87,8 @@ class DistributedClient:
                 body["priority"] = priority
             if session is not None:
                 body["session"] = session
+            if trace_id is not None:
+                body["trace_id"] = trace_id
             ticket = json.dumps(body)
         timeout = self._policy.stream_timeout_s if deadline_s is None \
             else deadline_s + min(5.0, self._policy.connect_timeout_s)

@@ -24,6 +24,7 @@ Parity map against the reference:
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextlib
 import json
 import os
 import threading
@@ -43,7 +44,7 @@ from igloo_tpu.engine import QueryEngine
 from igloo_tpu.errors import (
     DeadlineExceededError, IglooError, QueryCancelledError,
 )
-from igloo_tpu.utils import stats, tracing
+from igloo_tpu.utils import flight_recorder, stats, tracing
 
 #: default per-query deadline (seconds) for the distributed path; unset or
 #: <= 0 = unbounded. Precedence: per-call override > this env var > [rpc]
@@ -266,18 +267,21 @@ class DistributedExecutor:
                 deadline_s: Optional[float] = None,
                 qid: Optional[str] = None, sql: str = "",
                 adaptive_info: Optional[list] = None,
-                extra_metrics: Optional[dict] = None) -> pa.Table:
+                extra_metrics: Optional[dict] = None,
+                trace: Optional[flight_recorder.Trace] = None) -> pa.Table:
         schema, gen = self.execute_stream(fragments, deadline_s=deadline_s,
                                           qid=qid, sql=sql,
                                           adaptive_info=adaptive_info,
-                                          extra_metrics=extra_metrics)
+                                          extra_metrics=extra_metrics,
+                                          trace=trace)
         return pa.Table.from_batches(list(gen), schema=schema)
 
     def execute_stream(self, fragments: list[QueryFragment],
                        deadline_s: Optional[float] = None,
                        qid: Optional[str] = None, sql: str = "",
                        adaptive_info: Optional[list] = None,
-                       extra_metrics: Optional[dict] = None
+                       extra_metrics: Optional[dict] = None,
+                       trace: Optional[flight_recorder.Trace] = None
                        ) -> tuple[pa.Schema, object]:
         """Run the fragment waves, then return (schema, batch generator)
         streaming the root result from its worker — the coordinator never
@@ -301,6 +305,11 @@ class DistributedExecutor:
         token = CancelToken()
         with self._queries_lock:
             self._queries[qid] = token
+        if trace is not None:
+            # ownership handoff: this query's trace is now published by
+            # _finalize (at stream end / error), not by the do_get handler
+            trace.deferred = True
+            trace.qid = trace.qid or qid
         # per-QUERY metrics dict: concurrent queries each build their own and
         # publish atomically at the end (last_metrics = last finished query).
         # Per-fragment entries attribute wall time to dispatch (RPC + queue)
@@ -316,7 +325,15 @@ class DistributedExecutor:
                          # frags[fid].worker, so release must remember the
                          # evicted addr too — its handler may still be
                          # running and needs the tombstone
-                         "_addrs": set()}
+                         "_addrs": set(),
+                         # flight-recorder stitching surface: dispatch spans
+                         # + worker span trees land here; the root is the
+                         # do_get request scope's root span (captured on
+                         # THIS thread — the dispatch pool can't read it)
+                         "_trace": trace,
+                         "_trace_root": flight_recorder.current_root(),
+                         "trace_id": trace.trace_id if trace is not None
+                         else ""}
         if extra_metrics:
             # serving-path facts (queue_wait_s / priority / demoted) ride
             # beside the execution metrics into last_metrics + query_log
@@ -387,6 +404,12 @@ class DistributedExecutor:
                         self._recover(dead, frags, completed, pending,
                                       deadline)
                         metrics["recover_s"] += time.perf_counter() - t_rec
+                        if trace is not None:
+                            trace.add_span(
+                                "recover", tracing.epoch(t_rec), time.time(),
+                                parent_id=metrics["_trace_root"],
+                                proc="coordinator",
+                                dead=sorted(dead), recovery=recoveries)
             # open the root stream eagerly: the schema the worker reports is
             # authoritative, and a root holder lost between the last wave and
             # here surfaces now, while the caller can still see the error
@@ -418,7 +441,12 @@ class DistributedExecutor:
                     pass
             self._release(frags, completed, list(frags),
                           metrics["_addrs"])
-            self._unregister(qid, token)
+            # a stream abandoned before its first batch reaches this ONLY
+            # through the weakref finalizer — gen()'s except/finally never
+            # ran, so finalize here (release-only path: unregisters and
+            # publishes the partial trace; the _finalized guard makes this
+            # a no-op after any earlier finalize)
+            self._finalize(qid, metrics, t_start, sql, token=token)
 
         def gen():
             total_rows = 0
@@ -432,6 +460,16 @@ class DistributedExecutor:
                 metrics["fetch_s"] = round(time.perf_counter() - t_fetch, 6)
                 metrics["total_rows"] = total_rows
                 metrics["recoveries"] = recoveries
+                if trace is not None:
+                    # the root-result relay: open + batch-wise stream from
+                    # the root holder (recorded here, where it ends — the
+                    # relay spans threads, so a thread-local span cannot).
+                    # Top-level, not a child of the "query" root: the relay
+                    # OUTLIVES the do_get handler whose scope that root
+                    # times, and nesting is containment
+                    trace.add_span("fetch", tracing.epoch(t_fetch),
+                                   time.time(), proc="coordinator",
+                                   rows=total_rows)
                 self._finalize(qid, metrics, t_start, sql, completed=True,
                                token=token)
             except BaseException as ex:
@@ -488,6 +526,10 @@ class DistributedExecutor:
             if metrics.get("_finalized"):
                 return
             metrics["_finalized"] = True
+        # retire the stitched trace exactly once (the _finalized guard),
+        # whatever the outcome — a partial trace of a failed or abandoned
+        # query is exactly what the timeline is FOR
+        flight_recorder.publish(metrics.get("_trace"))
         if error is None and not completed:
             return
         status = "ok"
@@ -528,7 +570,8 @@ class DistributedExecutor:
                         status=status, started_at=t_start,
                         queue_wait_s=pub.get("queue_wait_s", 0.0),
                         priority=pub.get("priority", 1),
-                        demoted=pub.get("demoted", 0))
+                        demoted=pub.get("demoted", 0),
+                        trace_id=pub.get("trace_id", ""))
 
     def _record_adaptive(self, frag_infos: list) -> None:
         """Fold a finished query's per-fragment reports into the process-wide
@@ -596,23 +639,41 @@ class DistributedExecutor:
             # dep-fetches so a hung peer can't wedge the fragment either
             req["timeout_s"] = round(max(rem, 0.001), 3)
         pol = self._policy()
+        # flight-recorder: the dispatch span's id ships INSIDE the request
+        # as the worker-side parent, so the worker's span tree re-parents
+        # under this exact RPC on the stitched timeline
+        tr = metrics.get("_trace")
+        span_cm = tr.span("dispatch", parent_id=metrics.get("_trace_root"),
+                          proc="coordinator", frag=f.id, addr=f.worker) \
+            if tr is not None else contextlib.nullcontext()
         try:
             t0 = time.perf_counter()
-            # retries=0: re-dispatch is the RECOVERY layer's job — an RPC-
-            # level retry against the same hung worker would just double the
-            # time a dead worker stalls the wave. The per-dispatch bound is
-            # the HANG DETECTOR: under a query deadline it is call_timeout_s
-            # (clamped to the remaining budget) so rescue fits inside the
-            # deadline; without one, a dispatch runs QUERY work and gets the
-            # stream budget instead — a slow-but-legitimate fragment must
-            # not be misread as a hung worker at the control-action timeout
-            info = flight_action(f.worker, "execute_fragment", req,
-                                 policy=pol.with_(retries=0),
-                                 deadline=deadline,
-                                 timeout_s=(pol.call_timeout_s
-                                            if deadline is not None
-                                            else pol.stream_timeout_s))
+            with span_cm as span_id:
+                if span_id is not None:
+                    req["trace"] = {"trace_id": tr.trace_id,
+                                    "parent_id": span_id}
+                # retries=0: re-dispatch is the RECOVERY layer's job — an
+                # RPC-level retry against the same hung worker would just
+                # double the time a dead worker stalls the wave. The
+                # per-dispatch bound is the HANG DETECTOR: under a query
+                # deadline it is call_timeout_s (clamped to the remaining
+                # budget) so rescue fits inside the deadline; without one, a
+                # dispatch runs QUERY work and gets the stream budget
+                # instead — a slow-but-legitimate fragment must not be
+                # misread as a hung worker at the control-action timeout
+                info = flight_action(f.worker, "execute_fragment", req,
+                                     policy=pol.with_(retries=0),
+                                     deadline=deadline,
+                                     timeout_s=(pol.call_timeout_s
+                                                if deadline is not None
+                                                else pol.stream_timeout_s))
             wall = time.perf_counter() - t0
+            if tr is not None:
+                # stitch the worker's span tree into the query trace (and
+                # keep the metrics fragments lean — spans are trace data)
+                tr.extend(info.pop("spans", None))
+            else:
+                info.pop("spans", None)
             info["addr"] = f.worker
             if f.kind:
                 info["kind"] = f.kind
@@ -857,7 +918,8 @@ class CoordinatorServer(flight.FlightServerBase):
     def execute_sql(self, sql: str, stream: bool = False,
                     deadline_s: Optional[float] = None,
                     qid: Optional[str] = None, priority: int = 1,
-                    session: str = ""):
+                    session: str = "",
+                    trace: Optional[flight_recorder.Trace] = None):
         """-> pa.Table, or — for `stream=True` on the distributed path —
         (pa.Schema, record-batch generator) so do_get can relay the root
         worker's stream batch-wise instead of materializing it here.
@@ -885,7 +947,7 @@ class CoordinatorServer(flight.FlightServerBase):
             hit = self.engine.result_cache.get(rkey)
             if hit is not None:
                 return self._serve_cached(hit, sql, stream, t_start,
-                                          priority, qid)
+                                          priority, qid, trace=trace)
         try:
             permit = self.admission.submit(
                 priority=priority, session=session,
@@ -899,7 +961,7 @@ class CoordinatorServer(flight.FlightServerBase):
         try:
             out = self._execute_admitted(plan, sql, stream, deadline,
                                          deadline_s, qid, permit, rkey,
-                                         t_start)
+                                         t_start, trace=trace)
         except BaseException:
             permit.release()
             raise
@@ -915,7 +977,8 @@ class CoordinatorServer(flight.FlightServerBase):
     def _execute_admitted(self, plan, sql: str, stream: bool,
                           deadline: Optional[float],
                           deadline_s: Optional[float], qid: Optional[str],
-                          permit: "serving.Permit", rkey, t_start: float):
+                          permit: "serving.Permit", rkey, t_start: float,
+                          trace: Optional[flight_recorder.Trace] = None):
         """The admitted execution body: distributed when possible, local
         fallback otherwise, with the degradation ladder absorbing OOM."""
         if permit.demote:
@@ -964,12 +1027,13 @@ class CoordinatorServer(flight.FlightServerBase):
             if stream:
                 schema, gen = self.executor.execute_stream(
                     frags, deadline_s=deadline_s, qid=qid, sql=sql,
-                    adaptive_info=adaptive_info, extra_metrics=extra)
+                    adaptive_info=adaptive_info, extra_metrics=extra,
+                    trace=trace)
                 return schema, self._caching_stream(schema, gen, rkey)
             table = self.executor.execute(frags, deadline_s=deadline_s,
                                           qid=qid, sql=sql,
                                           adaptive_info=adaptive_info,
-                                          extra_metrics=extra)
+                                          extra_metrics=extra, trace=trace)
         except Exception as ex:
             if not _is_oom(ex):
                 raise
@@ -1068,18 +1132,20 @@ class CoordinatorServer(flight.FlightServerBase):
             self.engine.result_cache.put(rkey, table)
 
     def _serve_cached(self, hit: pa.Table, sql: str, stream: bool,
-                      t_start: float, priority: int, qid: Optional[str]):
+                      t_start: float, priority: int, qid: Optional[str],
+                      trace: Optional[flight_recorder.Trace] = None):
         """A front-door result-cache hit: no admission, no execution —
         publish attributable metrics (`result_cache_hit` in last_metrics,
         a tier=result_cache query-log row) and serve the cached table."""
         elapsed = time.time() - t_start
+        tid = trace.trace_id if trace is not None else ""
         self.executor.last_metrics = {
             "qid": qid or "", "result_cache_hit": True, "status": "ok",
             "rows": hit.num_rows, "fragments": [], "recoveries": 0,
-            "execution_time_s": round(elapsed, 6)}
+            "execution_time_s": round(elapsed, 6), "trace_id": tid}
         stats.log_query(sql, elapsed_s=elapsed, tier="result_cache",
                         rows=hit.num_rows, started_at=t_start,
-                        priority=priority)
+                        priority=priority, trace_id=tid)
         if stream:
             return hit.schema, iter(hit.to_batches())
         return hit
@@ -1196,6 +1262,18 @@ class CoordinatorServer(flight.FlightServerBase):
             }).encode()]
         if action.type == "last_metrics":
             return [json.dumps(self.executor.last_metrics).encode()]
+        if action.type == "trace":
+            # stitched query timeline by trace_id or qid (neither = most
+            # recent); Chrome-trace/Perfetto JSON by default, the raw span
+            # record with {"format": "raw"} (raw bytes — flight_action_raw)
+            rec = flight_recorder.get_record(req.get("trace_id"),
+                                             req.get("qid"))
+            if rec is None:
+                raise flight.FlightServerError(
+                    f"no such trace: {req.get('trace_id') or req.get('qid') or '<last>'}")
+            if req.get("format") == "raw":
+                return [json.dumps(rec).encode()]
+            return [json.dumps(flight_recorder.to_chrome_trace(rec)).encode()]
         if action.type == "serving_status":
             # admission queue / slot / HBM-reservation snapshot
             return [json.dumps(self.admission.snapshot()).encode()]
@@ -1233,6 +1311,9 @@ class CoordinatorServer(flight.FlightServerBase):
                 ("register_table", "register a table from a provider spec"),
                 ("cluster_status", "membership + catalog snapshot"),
                 ("last_metrics", "per-fragment metrics of the last query"),
+                ("trace", "stitched query timeline by trace_id/qid as "
+                          "Chrome-trace/Perfetto JSON (format=raw for the "
+                          "span record)"),
                 ("serving_status",
                  "admission queue / concurrency / HBM-reservation snapshot"),
                 ("metrics", "process + worker-aggregated fragment metrics, "
@@ -1258,10 +1339,10 @@ class CoordinatorServer(flight.FlightServerBase):
         faults.inject("coordinator.do_get")
         raw = ticket.ticket.decode()
         sql, deadline_s, qid = raw, None, None
-        priority, session = 1, ""
+        priority, session, trace_id = 1, "", None
         if raw.lstrip().startswith("{"):
             # extended ticket: {"sql": ..., "deadline_s": ..., "qid": ...,
-            # "priority": ..., "session": ...}
+            # "priority": ..., "session": ..., "trace_id": ...}
             # (SQL cannot start with "{", so plain-SQL tickets keep working)
             try:
                 d = json.loads(raw)
@@ -1279,18 +1360,44 @@ class CoordinatorServer(flight.FlightServerBase):
                     qid = str(qid)
                 priority = int(d.get("priority", 1))
                 session = str(d.get("session", ""))
+                # client-chosen trace identity: lets a caller correlate its
+                # own telemetry with the server-side stitched timeline
+                trace_id = d.get("trace_id")
+                if trace_id is not None:
+                    trace_id = str(trace_id)
             except (ValueError, KeyError, TypeError):
                 raise flight.FlightServerError(f"bad query ticket: {raw!r}")
+        trace = None
+        if flight_recorder.enabled():
+            trace = flight_recorder.Trace(trace_id=trace_id, qid=qid or "",
+                                          sql=sql)
         try:
-            out = self.execute_sql(sql, stream=True, deadline_s=deadline_s,
-                                   qid=qid, priority=priority,
-                                   session=session)
+            # span hygiene: the request scope gives this (reused gRPC)
+            # thread a fresh span stack per query and stitches whatever the
+            # execution records — planning, admission wait, local fallback
+            # spans — under one "query" root
+            with flight_recorder.request_scope(trace, "query",
+                                               proc="coordinator",
+                                               qid=qid or ""):
+                out = self.execute_sql(sql, stream=True,
+                                       deadline_s=deadline_s,
+                                       qid=qid, priority=priority,
+                                       session=session, trace=trace)
         except serving.ServerBusy as ex:
             # retryable by the client's RpcPolicy classification; carries
-            # the retry-after hint in the message (docs/serving.md)
+            # the retry-after hint in the message (docs/serving.md). Shed
+            # queries never publish a trace — under overload the ring would
+            # otherwise churn with empty shed records
             raise ex.as_flight_error()
         except IglooError as ex:
+            if trace is not None and not trace.deferred:
+                flight_recorder.publish(trace)
             raise flight.FlightServerError(str(ex))
+        if trace is not None and not trace.deferred:
+            # local / cached / non-SELECT paths: the result is materialized,
+            # the query is over — publish now. Distributed streams publish
+            # from the executor's finalize instead (trace.deferred).
+            flight_recorder.publish(trace)
         if isinstance(out, tuple):
             # distributed: relay the root worker's stream batch-wise
             return flight.GeneratorStream(

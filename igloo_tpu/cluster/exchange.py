@@ -263,14 +263,18 @@ class FragmentStore:
             salt: Optional[tuple] = None) -> _Stored:
         if partition is not None:
             keys, nb = partition
-            slices, base = salted_partition(table, list(keys), nb, salt)
-            batches, ranges, meta = [], [], []
-            for s in slices:
-                bs = _chunk(s)
-                ranges.append((len(batches), len(bs)))
-                batches.extend(bs)
-                meta.append({"rows": s.num_rows,
-                             "bytes": sum(b.nbytes for b in bs)})
+            # store-time hash partition on the query timeline: per-bucket
+            # slices of THIS fragment's result, the exchange's shuffle write
+            with tracing.span("exchange.partition", buckets=nb,
+                              rows=table.num_rows, salted=salt is not None):
+                slices, base = salted_partition(table, list(keys), nb, salt)
+                batches, ranges, meta = [], [], []
+                for s in slices:
+                    bs = _chunk(s)
+                    ranges.append((len(batches), len(bs)))
+                    batches.extend(bs)
+                    meta.append({"rows": s.num_rows,
+                                 "bytes": sum(b.nbytes for b in bs)})
             tracing.counter("exchange.partitions")
             tracing.counter("exchange.partition_rows", table.num_rows)
             ent = _Stored(schema=table.schema, batches=batches,
@@ -320,10 +324,11 @@ class FragmentStore:
         if self._tmpdir is None:
             self._tmpdir = tempfile.mkdtemp(prefix="igloo-fragstore-")
         path = os.path.join(self._tmpdir, f"{frag_id}.arrow".replace("/", "_"))
-        with pa.OSFile(path, "wb") as f, \
-                pa.ipc.new_file(f, ent.schema) as w:
-            for b in ent.batches:
-                w.write_batch(b)
+        with tracing.span("exchange.spill", bytes=ent.nbytes):
+            with pa.OSFile(path, "wb") as f, \
+                    pa.ipc.new_file(f, ent.schema) as w:
+                for b in ent.batches:
+                    w.write_batch(b)
         ent.spill_path = path
         ent.batches = None
         tracing.counter("exchange.spills")

@@ -25,6 +25,7 @@ Flight connection site — the igloo-lint `rpc-policy` checker flags
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -37,7 +38,7 @@ import pyarrow.flight as flight
 
 from igloo_tpu.cluster import faults
 from igloo_tpu.errors import DeadlineExceededError
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import flight_recorder, tracing
 
 AUTH_TOKEN_ENV = "IGLOO_TPU_AUTH_TOKEN"
 _HEADER = "x-igloo-token"
@@ -273,14 +274,22 @@ def _run_attempts(addr: str, what: str, fn, policy: Optional[RpcPolicy],
     path still closes it."""
     policy = policy or default_policy()
     attempt = 0
+    # timeline: inside an active flight-recorder scope each ATTEMPT is a
+    # span (attrs carry the retry ordinal), so retries/backoff against a
+    # flaky peer are visible on the stitched trace; outside a scope the
+    # recorder stays entirely out of the way
+    traced = flight_recorder.current() is not None
     while True:
         check_deadline(deadline, what)
         client = None
         ok = False
         try:
-            faults.inject(f"client.{what}")
-            client = connect(addr)
-            out = fn(client)
+            span_cm = tracing.span("rpc", what=what, attempt=attempt) \
+                if traced else contextlib.nullcontext()
+            with span_cm:
+                faults.inject(f"client.{what}")
+                client = connect(addr)
+                out = fn(client)
             ok = True
             return out
         except Exception as ex:

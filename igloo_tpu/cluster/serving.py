@@ -57,7 +57,7 @@ from collections import Counter, deque
 from typing import Optional
 
 from igloo_tpu.cluster import faults
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import flight_recorder, tracing
 
 # lock discipline (checked by igloo-lint lock-discipline): submissions run on
 # Flight RPC threads and releases on whichever thread finishes the query, so
@@ -140,7 +140,7 @@ class Permit:
     AND a weakref finalizer."""
 
     __slots__ = ("_controller", "wait_s", "priority", "session", "demote",
-                 "reserve_bytes", "_mode", "_released")
+                 "reserve_bytes", "_mode", "_released", "_trace_ctx", "_t0")
 
     def __init__(self, controller, priority: int, session: str,
                  demote: bool = False, reserve_bytes: int = 0,
@@ -153,11 +153,30 @@ class Permit:
         self.wait_s = wait_s
         self._mode = mode                   # admitted | serial | bypass
         self._released = False
+        # flight-recorder hold span: the permit is granted on the request
+        # thread (trace context capturable) but released by whichever thread
+        # finishes the stream — so the hold is recorded AT release, into the
+        # trace captured here (docs/observability.md#distributed-tracing)
+        self._trace_ctx = flight_recorder.capture() \
+            if mode == "admitted" else (None, None, None)
+        self._t0 = time.time()
 
     def release(self) -> None:
         if self._released:
             return
         self._released = True
+        trace, _parent, proc = self._trace_ctx
+        if trace is not None:
+            # concurrency-slot + HBM-reservation hold: how long this query
+            # occupied its admission (the dark time between "admitted" and
+            # "stream finished" that queue-wait alone never showed).
+            # Top-level: the hold outlives the request scope's root span
+            # (it releases when the result STREAM drains), so nesting it
+            # under the root would break containment
+            trace.add_span("serving.hbm_hold", self._t0, time.time(),
+                           proc=proc,
+                           reserve_bytes=self.reserve_bytes,
+                           priority=self.priority)
         if self._mode == "admitted":
             self._controller._release(self)
         elif self._mode == "serial":
@@ -255,7 +274,9 @@ class AdmissionController:
             if self.hbm_budget_bytes else 0
         w = _Waiter(priority, session, reserve, demote)
         t0 = time.perf_counter()
-        with self._cond:
+        # timeline: the admission wait is a first-class span — a query slow
+        # because it QUEUED (vs executed slowly) is visibly different
+        with tracing.span("serving.queue", priority=priority), self._cond:
             if self._sessions[session] >= self.session_inflight:
                 tracing.counter("serving.shed")
                 tracing.counter("serving.shed_session")

@@ -47,7 +47,7 @@ from igloo_tpu.cluster.rpc import flight_action, flight_stream_batches
 from igloo_tpu.cluster.rpc import normalize as _normalize
 from igloo_tpu.errors import IglooError
 from igloo_tpu.plan import logical as L
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import flight_recorder, tracing
 
 
 # lock discipline (checked by igloo-lint lock-discipline): Flight serves
@@ -215,15 +215,20 @@ class WorkerServer(flight.FlightServerBase):
         # coordinator as a relative timeout_s) — a HUNG peer becomes
         # DEP_UNAVAILABLE at the deadline instead of wedging the fragment.
         try:
-            ticket = exchange.make_ticket(frag_id, bucket, nbuckets)
-            schema, batch_iter = flight_stream_batches(addr, ticket,
-                                                       deadline=deadline)
-            batches = []
-            for batch in batch_iter:
-                batches.append(batch)
-                tracing.counter("exchange.fetch_rows", batch.num_rows)
-                tracing.counter("exchange.fetch_bytes", batch.nbytes)
-            table = pa.Table.from_batches(batches, schema=schema)
+            with tracing.span("exchange.fetch", frag=frag_id,
+                              bucket=bucket, addr=addr) as sp:
+                ticket = exchange.make_ticket(frag_id, bucket, nbuckets)
+                schema, batch_iter = flight_stream_batches(addr, ticket,
+                                                           deadline=deadline)
+                batches = []
+                nbytes = 0
+                for batch in batch_iter:
+                    batches.append(batch)
+                    nbytes += batch.nbytes
+                    tracing.counter("exchange.fetch_rows", batch.num_rows)
+                    tracing.counter("exchange.fetch_bytes", batch.nbytes)
+                table = pa.Table.from_batches(batches, schema=schema)
+                sp.attrs.update(rows=table.num_rows, bytes=nbytes)
         except Exception as ex:
             raise IglooError(f"DEP_UNAVAILABLE:{frag_id} peer {addr}: {ex}")
         # keep the slice in the budgeted store: co-located dependents reuse
@@ -270,11 +275,15 @@ class WorkerServer(flight.FlightServerBase):
                     salt = (plan.salt_bucket, plan.salt, plan.salt_role)
                 plan = plan.input
             t0 = time.perf_counter()
-            ex = self._executor(plan)
-            table = ex.execute_to_arrow(plan)
+            with tracing.span("fragment.execute") as sp:
+                ex = self._executor(plan)
+                table = ex.execute_to_arrow(plan)
+                sp.attrs = {"rows": table.num_rows,
+                            "mesh_devices": int(getattr(ex, "n_dev", 1))}
             elapsed = time.perf_counter() - t0
-            ent = self._store.put(frag_id, table, partition=partition,
-                                  salt=salt)
+            with tracing.span("fragment.store"):
+                ent = self._store.put(frag_id, table, partition=partition,
+                                      salt=salt)
         tracing.counter("worker.fragments")
         # local mesh-tier attribution: how many chips this fragment ran
         # across (1 = single-device) and its result rows per chip — the
@@ -314,29 +323,50 @@ class WorkerServer(flight.FlightServerBase):
         body = action.body.to_pybytes() if action.body is not None else b""
         req = json.loads(body) if body else {}
         if action.type == "execute_fragment":
-            # slot bound: a saturated worker must answer with the WORKER_BUSY
-            # marker BEFORE the coordinator's dispatch RPC deadline concludes
-            # it is hung (call_timeout_s=120 under a query deadline, the
-            # stream bound without one) — so the wait is capped at half a
-            # short bound, never the fragment's full deadline. The
-            # coordinator REQUEUES a busy fragment without evicting us.
-            wait_s = min(float(req.get("timeout_s") or 60.0), 60.0) / 2
-            t0 = time.perf_counter()
-            if not self._slots.acquire(timeout=max(wait_s, 0.001)):
-                tracing.counter("worker.slot_timeouts")
-                raise flight.FlightUnavailableError(
-                    f"WORKER_BUSY worker {self.worker_id}: all {self.slots} "
-                    "execution slots busy")
-            tracing.gauge_add("worker.slots_busy", 1)
-            tracing.histogram("worker.slot_wait_s",
-                              time.perf_counter() - t0)
-            try:
-                out = self._execute_fragment(req)
-            except IglooError as ex:
-                raise flight.FlightServerError(f"fragment failed: {ex}")
-            finally:
-                tracing.gauge_add("worker.slots_busy", -1)
-                self._slots.release()
+            # flight-recorder: the dispatch request carries the query's
+            # trace context; this worker's span tree (rooted at a fresh
+            # request scope — span hygiene for the reused gRPC thread) rides
+            # back beside the fragment stats for the coordinator to stitch
+            ctx = req.get("trace") or {}
+            trace = None
+            if ctx.get("trace_id") and flight_recorder.enabled():
+                trace = flight_recorder.Trace(trace_id=ctx["trace_id"],
+                                              qid=str(req.get("id", "")))
+            with flight_recorder.request_scope(
+                    trace, "execute_fragment",
+                    proc=f"worker:{self.worker_id}",
+                    parent_id=ctx.get("parent_id"), frag=req.get("id", "")):
+                # slot bound: a saturated worker must answer with the
+                # WORKER_BUSY marker BEFORE the coordinator's dispatch RPC
+                # deadline concludes it is hung (call_timeout_s=120 under a
+                # query deadline, the stream bound without one) — so the
+                # wait is capped at half a short bound, never the fragment's
+                # full deadline. The coordinator REQUEUES a busy fragment
+                # without evicting us.
+                wait_s = min(float(req.get("timeout_s") or 60.0), 60.0) / 2
+                t0 = time.perf_counter()
+                with tracing.span("worker.slot_wait") as sp:
+                    ok = self._slots.acquire(timeout=max(wait_s, 0.001))
+                    sp.attrs = {"acquired": ok}
+                if not ok:
+                    tracing.counter("worker.slot_timeouts")
+                    raise flight.FlightUnavailableError(
+                        f"WORKER_BUSY worker {self.worker_id}: all "
+                        f"{self.slots} execution slots busy")
+                tracing.gauge_add("worker.slots_busy", 1)
+                tracing.histogram("worker.slot_wait_s",
+                                  time.perf_counter() - t0)
+                try:
+                    out = self._execute_fragment(req)
+                except IglooError as ex:
+                    raise flight.FlightServerError(f"fragment failed: {ex}")
+                finally:
+                    tracing.gauge_add("worker.slots_busy", -1)
+                    self._slots.release()
+            if trace is not None:
+                # read AFTER the scope exit — that is when the thread-local
+                # span tree flushes into the trace
+                out["spans"] = trace.spans()
             return [json.dumps(out).encode()]
         if action.type == "register_table":
             provider = serde.provider_from_spec(req["spec"])

@@ -239,6 +239,14 @@ class QueryEngine:
                                  f"{lanes // uploads // max(ndev, 1)}")
                     else:
                         text += " (batches served from the scan cache)"
+                if qs.trace_id:
+                    # flight-recorder pointer: the executed query's stitched
+                    # timeline, queryable in SQL or exportable for Perfetto
+                    # (docs/observability.md#distributed-tracing)
+                    text += (f"\n-- trace: {qs.trace_id} (SELECT * FROM "
+                             "system.query_traces WHERE trace_id = "
+                             f"'{qs.trace_id}'; coordinator 'trace' action; "
+                             "IGLOO_TRACE_DIR)")
             return QueryResult(pa.table({"plan": text.split("\n")}), plan=plan,
                                elapsed_s=time.perf_counter() - t0, stats=qs)
         if isinstance(stmt, A.CreateTableAsStmt):

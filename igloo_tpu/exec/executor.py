@@ -139,20 +139,37 @@ class _CompileTimed:
     """One-shot wrapper returned by `_jitted` on a cache miss when a query is
     being collected: times the FIRST call (where jax traces, lowers and
     compiles synchronously before dispatch) and attributes it to the current
-    operator as compile time. Never cached — later calls get the raw fn."""
-    __slots__ = ("fn",)
+    operator as compile time. Never cached — later calls get the raw fn.
+    Under IGLOO_TRACE_DEVICE=1 the first call is bracketed in a named
+    TraceAnnotation so the compile lands attributably in the jax profiler's
+    Perfetto timeline."""
+    __slots__ = ("fn", "kind")
 
-    def __init__(self, fn):
+    def __init__(self, fn, kind: str = ""):
         self.fn = fn
+        self.kind = kind
 
     def __call__(self, *args, **kw):
         t0 = time.perf_counter()
         try:
-            return self.fn(*args, **kw)
+            with tracing.device_annotation(f"igloo:compile:{self.kind}"):
+                return self.fn(*args, **kw)
         finally:
             dt = time.perf_counter() - t0
             stats.record_compile(dt)
             tracing.histogram("compile.first_call_s", dt)
+
+
+def _device_annotated(fn, kind: str):
+    """Execute-side half of the IGLOO_TRACE_DEVICE bridge: every dispatch of
+    this program runs inside a named TraceAnnotation. Only built when the
+    bridge is on — the off path returns the raw fn untouched."""
+    name = f"igloo:execute:{kind}"
+
+    def run(*args, **kw):
+        with tracing.device_annotation(name):
+            return fn(*args, **kw)
+    return run
 
 
 class Executor:
@@ -207,10 +224,15 @@ class Executor:
             if stats.current() is not None:
                 # the raw fn is what got cached; the wrapper lives for this
                 # one first call and books it as the node's compile cost
-                return _CompileTimed(fn)
+                fn = _CompileTimed(fn, kind)
+                if tracing.device_trace_enabled():
+                    fn = _device_annotated(fn, kind)
+                return fn
         else:
             tracing.counter("jit.hit")
             stats.bump_attr("jit_hit")
+        if tracing.device_trace_enabled():
+            return _device_annotated(fn, kind)
         return fn
 
     # --- entry ---

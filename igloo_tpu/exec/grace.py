@@ -60,6 +60,7 @@ build sides in RAM HashMaps, crates/engine/src/operators/hash_join.rs:100-128).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -86,6 +87,11 @@ MAX_GRACE_DEPTH = 3
 # the rest contribute to the per-partition ROLLUP only (a 1024-partition
 # query must not materialize 1024 stats subtrees)
 DETAIL_PARTITIONS = 4
+
+#: partitions that land as flight-recorder timeline spans (grace.partition /
+#: grace.prefetch): enough to SEE the double-buffer overlap in Perfetto,
+#: bounded so a 1024-partition query doesn't bloat its trace
+_SPAN_PARTITIONS = 64
 
 _INTERIOR_JOINS = (JoinType.INNER, JoinType.SEMI, JoinType.ANTI)
 
@@ -623,11 +629,17 @@ class GraceJoinExecutor:
                 """One partition's plan on device; rows (host Arrow — free)
                 and wall feed the per-partition rollup. The first few
                 partitions keep full operator subtrees under EXPLAIN
-                ANALYZE; the rest are recorded quiet (rollup only)."""
+                ANALYZE; the rest are recorded quiet (rollup only). The
+                first _SPAN_PARTITIONS land as `grace.partition` timeline
+                spans — on the Perfetto view they visibly overlap the
+                prefetch thread's `grace.prefetch` spans, which is the
+                double-buffer's win made observable."""
                 tp = time.perf_counter()
                 keep = stats.detail_active() and k < DETAIL_PARTITIONS
                 cm = stats.op(f"Partition[{p}]") if keep else stats.quiet()
-                with cm:
+                span_cm = tracing.span("grace.partition", partition=p) \
+                    if k < _SPAN_PARTITIONS else contextlib.nullcontext()
+                with span_cm, cm:
                     tbl = self._leaf_routed(build_sub(provs), depth)
                     if keep:
                         stats.set_rows(tbl.num_rows)
@@ -643,16 +655,28 @@ class GraceJoinExecutor:
                     # so its uploads/counters land in the right deltas
                     sctx = stats.capture()
 
-                    def prepare_traced(p: int) -> dict:
+                    def prepare_traced(k: int, p: int) -> dict:
+                        # the adopted trace context puts the prefetch span
+                        # in the SAME query trace as the compute spans it
+                        # overlaps. Gated on the execution ORDINAL k, same
+                        # as grace.partition — skipped-empty-partition runs
+                        # have sparse partition IDs, and gating the two
+                        # halves differently would trace compute without
+                        # its overlapping prefetch
                         with stats.adopt(sctx):
-                            return prepare(p)
+                            span_cm = tracing.span("grace.prefetch",
+                                                   partition=p) \
+                                if k < _SPAN_PARTITIONS \
+                                else contextlib.nullcontext()
+                            with span_cm:
+                                return prepare(p)
 
                     with ThreadPoolExecutor(max_workers=1) as pool:
-                        fut = pool.submit(prepare_traced, run_ps[0])
+                        fut = pool.submit(prepare_traced, 0, run_ps[0])
                         for k, p in enumerate(run_ps):
                             provs = fut.result()
                             if k + 1 < len(run_ps):
-                                fut = pool.submit(prepare_traced,
+                                fut = pool.submit(prepare_traced, k + 1,
                                                   run_ps[k + 1])
                             run_partition(k, p, provs)
                 else:

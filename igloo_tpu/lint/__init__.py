@@ -28,6 +28,10 @@ visitors (docs/static_analysis.md has the rule catalog):
 - ``metric-names``    tracing counter/histogram names must match the catalog
                       in docs/observability.md (migrated from
                       scripts/check_metrics_names.py);
+- ``span-names``      flight-recorder span names (tracing.span /
+                      Trace.add_span / request_scope) must match the Span
+                      catalog in docs/observability.md — timeline names
+                      must not typo-fork any more than metric names can;
 - ``rpc-policy``      no ``flight.connect`` / ``FlightClient`` outside
                       ``cluster/rpc.py`` — every Flight connection must run
                       under the RPC policy (deadlines, retry/backoff), or a
@@ -150,10 +154,11 @@ def default_checkers() -> list:
     from igloo_tpu.lint.metric_names import MetricNamesChecker
     from igloo_tpu.lint.pallas_dispatch import PallasDispatchChecker
     from igloo_tpu.lint.rpc_policy import RpcPolicyChecker
+    from igloo_tpu.lint.span_names import SpanNamesChecker
     from igloo_tpu.lint.sync_hazard import SyncHazardChecker
     return [SyncHazardChecker(), CacheKeyChecker(), JitKeyChecker(),
             LockDisciplineChecker(), MetricNamesChecker(),
-            RpcPolicyChecker(), PallasDispatchChecker()]
+            SpanNamesChecker(), RpcPolicyChecker(), PallasDispatchChecker()]
 
 
 def run_lint(paths: Optional[list] = None, checkers: Optional[list] = None,

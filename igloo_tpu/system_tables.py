@@ -14,12 +14,13 @@ documented-contract change, not a refactor.
 """
 from __future__ import annotations
 
+import json
 from typing import Optional
 
 import pyarrow as pa
 
 from igloo_tpu.exec.batch import schema_from_arrow
-from igloo_tpu.utils import stats, tracing
+from igloo_tpu.utils import flight_recorder, stats, tracing
 
 
 class _SystemTable:
@@ -124,6 +125,9 @@ class QueryLogTable(_SystemTable):
         pa.field("queue_wait_s", pa.float64(), False),
         pa.field("priority", pa.int64(), False),
         pa.field("demoted", pa.int64(), False),
+        # flight-recorder join key: logs, metrics, and the stitched trace
+        # (system.query_traces) correlate on this one id ("" = recorder off)
+        pa.field("trace_id", pa.string(), False),
     ])
 
     def _build(self) -> pa.Table:
@@ -135,7 +139,48 @@ class QueryLogTable(_SystemTable):
             schema=self._arrow_schema)
 
 
+class QueryTracesTable(_SystemTable):
+    """`system.query_traces`: one row per SPAN of every ring-resident query
+    trace (utils/flight_recorder.py), most recent trace last. Joins with
+    system.query_log on `trace_id`; `parent_id` is '' for root spans; `args`
+    is the span's attributes as a JSON string. The publish path bumps the
+    metrics-registry version, so scans always see live traces."""
+
+    _arrow_schema = pa.schema([
+        pa.field("trace_id", pa.string(), False),
+        pa.field("qid", pa.string(), False),
+        pa.field("span_id", pa.string(), False),
+        pa.field("parent_id", pa.string(), False),
+        pa.field("name", pa.string(), False),
+        pa.field("proc", pa.string(), False),
+        pa.field("t0", pa.float64(), False),
+        pa.field("dur_s", pa.float64(), False),
+        pa.field("args", pa.string(), False),
+    ])
+
+    def _build(self) -> pa.Table:
+        cols: dict = {f.name: [] for f in self._arrow_schema}
+        for rec in flight_recorder.records():
+            for s in rec.get("spans", ()):
+                cols["trace_id"].append(rec.get("trace_id", ""))
+                cols["qid"].append(str(rec.get("qid", "")))
+                cols["span_id"].append(str(s.get("id", "")))
+                cols["parent_id"].append(str(s.get("parent") or ""))
+                cols["name"].append(str(s.get("name", "")))
+                cols["proc"].append(str(s.get("proc", "")))
+                cols["t0"].append(float(s.get("t0", 0.0)))
+                cols["dur_s"].append(
+                    round(max(float(s.get("t1", 0.0)) -
+                              float(s.get("t0", 0.0)), 0.0), 7))
+                cols["args"].append(json.dumps(s.get("args") or {},
+                                               default=str))
+        return pa.Table.from_arrays(
+            [pa.array(cols[f.name], type=f.type) for f in self._arrow_schema],
+            schema=self._arrow_schema)
+
+
 def register_system_tables(catalog) -> None:
     """Install the system namespace into a catalog (engine construction)."""
     catalog.register_system("system.metrics", MetricsTable())
     catalog.register_system("system.query_log", QueryLogTable())
+    catalog.register_system("system.query_traces", QueryTracesTable())

@@ -34,7 +34,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
-from igloo_tpu.utils import tracing
+from igloo_tpu.utils import flight_recorder, tracing
 
 _tls = threading.local()
 
@@ -95,6 +95,10 @@ class QueryStats:
     queue_wait_s: float = 0.0
     priority: int = 1
     demoted: int = 0
+    # flight-recorder trace identity (utils/flight_recorder.py): the key
+    # that joins this row with system.query_traces and the Perfetto export
+    # ("" when the recorder was off)
+    trace_id: str = ""
     # (fingerprint key, observed rows) pairs recorded where a row count was
     # free or already paid for (host tier, detail-mode syncs, first-sight
     # adaptive-input syncs); the engine folds them into the process-wide
@@ -139,6 +143,7 @@ class QueryStats:
             "queue_wait_s": round(self.queue_wait_s, 6),
             "priority": int(self.priority),
             "demoted": int(self.demoted),
+            "trace_id": self.trace_id,
         }
 
 
@@ -167,6 +172,19 @@ def collect(sql: str = "", detail: bool = False, log: bool = True):
         _query_seq += 1
         qid = _query_seq
     qs = QueryStats(sql=sql, started_at=time.time(), detail=detail, qid=qid)
+    # flight-recorder hookup (utils/flight_recorder.py): an ambient trace (a
+    # coordinator request scope around this execution) is joined; otherwise
+    # a standalone engine opens — and at the end publishes — its own, with
+    # keep_roots so same-thread span consumers (CLI --timing) still work
+    trace = flight_recorder.current()
+    own_scope = None
+    if trace is None and flight_recorder.enabled():
+        trace = flight_recorder.Trace(qid=qid, sql=sql)
+        own_scope = flight_recorder.request_scope(trace, "query",
+                                                  keep_roots=True)
+        own_scope.__enter__()
+    if trace is not None:
+        qs.trace_id = trace.trace_id
     root = OpStats("Query")
     qs.root = root
     _tls.qstats = qs
@@ -190,6 +208,9 @@ def collect(sql: str = "", detail: bool = False, log: bool = True):
             qs.demoted = sv.get("demoted", 0)
         _tls.qstats = None
         _tls.opstack = None
+        if own_scope is not None:
+            own_scope.__exit__(None, None, None)
+            flight_recorder.publish(trace)
         if log:
             _append_log(qs)
 
@@ -407,27 +428,28 @@ def mark_demoted() -> None:
 
 
 def capture() -> tuple:
-    """Snapshot (qstats, opstack top, collectors) for a worker thread doing
-    this query's work (GRACE prefetch): its transfers/counters then land in
-    the right query's totals."""
+    """Snapshot (qstats, opstack top, collectors, trace context) for a
+    worker thread doing this query's work (GRACE prefetch): its transfers/
+    counters land in the right query's totals and its spans in the right
+    query's trace (where they visibly overlap the spawning thread's)."""
     return (getattr(_tls, "qstats", None), current_op(),
-            tracing.capture_collectors())
+            tracing.capture_collectors(), flight_recorder.capture())
 
 
 @contextlib.contextmanager
 def adopt(ctx: tuple):
-    qs, node, cols = ctx
+    qs, node, cols, tctx = ctx
     if qs is None:
         # no stats collection, but the parent thread may still hold
         # counter_delta collectors (bench sweep) — adopt those regardless
-        with tracing.adopt_collectors(cols):
+        with flight_recorder.adopt(tctx), tracing.adopt_collectors(cols):
             yield
         return
     _tls.qstats = qs
     _tls.opstack = [node if node is not None else qs.root]
     _tls.quiet = 1  # worker threads contribute totals, not tree nodes
     try:
-        with tracing.adopt_collectors(cols):
+        with flight_recorder.adopt(tctx), tracing.adopt_collectors(cols):
             yield
     finally:
         _tls.qstats = None
@@ -465,7 +487,7 @@ def log_query(sql: str, elapsed_s: float, tier: str = "distributed",
               rows: Optional[int] = None, status: str = "ok",
               started_at: Optional[float] = None,
               queue_wait_s: float = 0.0, priority: int = 1,
-              demoted: int = 0) -> QueryStats:
+              demoted: int = 0, trace_id: str = "") -> QueryStats:
     """Append a query-log record for a query NOT executed through
     `collect()` — the coordinator's distributed path logs every query here,
     including cancelled / deadline-exceeded / shed ones that never finished
@@ -480,7 +502,7 @@ def log_query(sql: str, elapsed_s: float, tier: str = "distributed",
                     started_at=started_at if started_at is not None
                     else time.time() - elapsed_s,
                     queue_wait_s=queue_wait_s, priority=priority,
-                    demoted=demoted)
+                    demoted=demoted, trace_id=trace_id)
     _append_log(qs)
     return qs
 

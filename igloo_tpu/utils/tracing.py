@@ -21,10 +21,13 @@ igloo_tpu.lint`) fails the verify flow when the two drift.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import logging
+import os
 import re
 import threading
 import time
+import uuid
 from collections import Counter, deque
 from dataclasses import dataclass, field
 from typing import Optional
@@ -32,6 +35,34 @@ from typing import Optional
 log = logging.getLogger("igloo_tpu")
 
 _tls = threading.local()
+
+# wall-clock anchor for spans: spans time with perf_counter (cheap, monotonic)
+# and `epoch()` maps those instants onto unix time so spans from DIFFERENT
+# processes line up on one timeline (utils/flight_recorder.py). Computed once
+# at import — NTP drift over a process lifetime is noise at span granularity.
+_EPOCH_OFFSET = time.time() - time.perf_counter()
+
+# span identity: ids must be unique ACROSS processes (a stitched trace mixes
+# coordinator and worker spans), so a per-process random prefix + a cheap
+# atomic counter (itertools.count.__next__ is C-level thread-safe) — ~100x
+# cheaper than a uuid4 per span; trace ids use the same scheme (one is
+# minted per query, on the hot serving path)
+_SPAN_PREFIX = uuid.uuid4().hex[:8]
+_span_ids = itertools.count(1)
+_trace_ids = itertools.count(1)
+
+
+def new_span_id() -> str:
+    return f"{_SPAN_PREFIX}-{next(_span_ids):x}"
+
+
+def new_trace_id() -> str:
+    return f"{_SPAN_PREFIX}{next(_trace_ids):08x}"
+
+
+def epoch(perf_t: float) -> float:
+    """Map a `time.perf_counter()` instant onto unix epoch seconds."""
+    return perf_t + _EPOCH_OFFSET
 
 # spans kept per thread: enough for tooling that reads a few recent queries,
 # bounded so a server thread answering queries for days cannot grow without
@@ -324,6 +355,12 @@ class Span:
     start: float
     end: float = 0.0
     children: list = field(default_factory=list)
+    # flight-recorder identity (utils/flight_recorder.py): stable across the
+    # wire so a worker's span tree re-parents under the coordinator's
+    # dispatch span. `attrs` land in the Perfetto event's args.
+    span_id: str = ""
+    parent_id: Optional[str] = None
+    attrs: Optional[dict] = None
 
     @property
     def elapsed_s(self) -> float:
@@ -337,10 +374,11 @@ class Span:
 
 
 def _stack() -> list:
-    if not hasattr(_tls, "stack"):
-        _tls.stack = []
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
         _tls.roots = deque(maxlen=ROOTS_MAX)
-    return _tls.stack
+    return stack
 
 
 def roots() -> deque:
@@ -359,18 +397,97 @@ def reset(counters_too: bool = False) -> None:
         reset_counters()
 
 
-@contextlib.contextmanager
-def span(name: str):
-    s = Span(name, time.perf_counter())
+def push_scope() -> tuple:
+    """Install a FRESH thread-local span stack/roots, returning a token for
+    `pop_scope`. The flight recorder opens one per server request so a
+    long-lived gRPC thread cannot accumulate spans toward the deque bound or
+    interleave spans from unrelated queries (span hygiene)."""
+    tok = (getattr(_tls, "stack", None), getattr(_tls, "roots", None))
+    _tls.stack = []
+    _tls.roots = deque(maxlen=ROOTS_MAX)
+    return tok
+
+
+def pop_scope(tok: tuple, keep_roots: bool = False) -> list:
+    """Restore the pre-`push_scope` state; returns the spans the scope
+    collected. With `keep_roots` the collected roots are re-appended to the
+    restored deque so same-thread consumers (CLI --timing via `last_trace`)
+    still see them."""
+    collected = list(getattr(_tls, "roots", ()))
+    _tls.stack, _tls.roots = tok
+    if keep_roots and collected:
+        _stack()  # re-init if the restored state was never initialized
+        _tls.roots.extend(collected)
+    return collected
+
+
+def current_span_id() -> Optional[str]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1].span_id if stack else None
+
+
+class _SpanCtx:
+    """Class-based span context (a @contextmanager generator costs ~2x as
+    much, and spans sit on per-operator and per-RPC paths)."""
+    __slots__ = ("span",)
+
+    def __init__(self, s: Span):
+        self.span = s
+
+    def __enter__(self) -> Span:
+        return self.span
+
+    def __exit__(self, *exc):
+        self.span.end = time.perf_counter()
+        _tls.stack.pop()
+        return False
+
+
+def span(name: str, **attrs) -> _SpanCtx:
+    s = Span(name, time.perf_counter(), span_id=new_span_id(),
+             attrs=attrs or None)
     stack = _stack()
-    (stack[-1].children if stack else _tls.roots).append(s)
+    if stack:
+        s.parent_id = stack[-1].span_id
+        stack[-1].children.append(s)
+    else:
+        _tls.roots.append(s)
     stack.append(s)
+    return _SpanCtx(s)
+
+
+# --- device-trace bridge (IGLOO_TRACE_DEVICE=1) ------------------------------
+
+
+_device_trace: Optional[bool] = None
+
+
+def device_trace_enabled() -> bool:
+    """Opt-in jax.profiler bridge: when IGLOO_TRACE_DEVICE=1 the executor
+    brackets compile/execute in named TraceAnnotations so device time lands
+    in the same Perfetto UI as the flight-recorder spans. Read once (the
+    check sits on the jit dispatch path)."""
+    global _device_trace
+    if _device_trace is None:
+        _device_trace = os.environ.get("IGLOO_TRACE_DEVICE", "0") == "1"
+    return _device_trace
+
+
+@contextlib.contextmanager
+def device_annotation(name: str):
+    """A named `jax.profiler.TraceAnnotation` around a block (no-op when the
+    device bridge is off or the profiler is unavailable)."""
+    if not device_trace_enabled():
+        yield
+        return
+    import jax
     try:
-        yield s
-    finally:
-        s.end = time.perf_counter()
-        stack.pop()
-        log.debug("span %s took %.3fms", name, s.elapsed_s * 1e3)
+        cm = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        yield
+        return
+    with cm:
+        yield
 
 
 def last_trace(n: int = 2) -> str:

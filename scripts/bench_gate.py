@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Perf-regression gate: diff a TPC-H sweep against the committed baseline.
+
+Wired into scripts/validate.sh so a perf regression fails the same flow that
+lint and chaos do. The gate compares PER-QUERY warm medians (with a
+multiplicative tolerance + an absolute slack, because warm times on shared
+CI boxes are noisy) and the counter deltas that EXPLAIN a regression (a
+route flip to GRACE, a jit-cache fragmentation, a kernel-overflow fallback):
+a counter that jumps past its tolerance fails the gate even when the wall
+time squeaked by, because it will not squeak by on the next machine.
+
+Inputs the gate understands:
+  - a baseline file (default BENCH_BASELINE.json, committed — initially cut
+    from BENCH_r05): {"queries": {q: {"warm_med_s": .., "counters": {..}}},
+    "warm_tol": .., "abs_slack_s": .., "counter_tol": ..}
+  - a candidate sweep: an explicit path, or (default) the newest
+    BENCH_r<k>.json / BENCH_DETAIL.json in the repo root. Three formats are
+    accepted: bench.py's detail blob ({"queries": {...}}), a round artifact
+    wrapper ({"tail": "..."} — per-query records are brace-extracted from
+    the tail), or a baseline-shaped file.
+
+Modes:
+  bench_gate.py [candidate]        gate the candidate (exit 1 on regression)
+  bench_gate.py --selftest         prove the gate trips: the committed
+                                   baseline vs itself must PASS, vs a
+                                   doctored 3x-warm copy must FAIL
+  bench_gate.py --write-baseline   cut a new baseline from the candidate
+  bench_gate.py --run-sweep        run `python bench.py` first, then gate
+                                   BENCH_DETAIL.json (full ~20 min sweep)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_DEFAULT = os.path.join(REPO, "BENCH_BASELINE.json")
+
+DEFAULT_WARM_TOL = 1.6       # candidate warm may be up to 1.6x the baseline
+DEFAULT_ABS_SLACK_S = 0.08   # plus this absolute slack (sub-100ms queries
+#                              are dominated by scheduler noise)
+DEFAULT_COUNTER_TOL = 1.5    # watched counters may grow up to 1.5x (+4 abs)
+COUNTER_ABS_SLACK = 4
+
+#: the per-query cold-run counter deltas whose growth EXPLAINS regressions:
+#: compile-cache fragmentation, out-of-core route flips, kernel/speculation
+#: fallback re-runs, exchange spills
+WATCH_COUNTERS = (
+    "jit.miss",
+    "engine.grace_route",
+    "engine.chunked_route",
+    "grace.partitions",
+    "join.speculation_overflow",
+    "fused.compact_repair",
+    "pallas.probe_overflow",
+    "pallas.agg_overflow",
+    "exchange.spills",
+)
+
+
+def _extract_tail_queries(tail: str) -> dict:
+    """Per-query records out of a round artifact's (possibly mid-JSON
+    truncated) stdout tail: find each `"qN": {` and brace-match the object.
+    Records containing "error" (SF10 stall entries) are skipped."""
+    out: dict = {}
+    for m in re.finditer(r'"(q\d+)":\s*\{', tail):
+        q = m.group(1)
+        i = m.end() - 1
+        depth = 0
+        for j in range(i, len(tail)):
+            if tail[j] == "{":
+                depth += 1
+            elif tail[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        rec = json.loads(tail[i:j + 1])
+                    except ValueError:
+                        rec = None
+                    # first occurrence wins: the SF1 block precedes SF10
+                    if isinstance(rec, dict) and "error" not in rec \
+                            and q not in out and "warm_med_s" in rec:
+                        out[q] = rec
+                    break
+    return out
+
+
+def load_queries(path: str) -> dict:
+    """q -> record (needs at least warm_med_s) from any accepted format."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("queries"), dict):
+        return {q: r for q, r in data["queries"].items()
+                if isinstance(r, dict) and "warm_med_s" in r
+                and "error" not in r}
+    if isinstance(data, dict) and isinstance(data.get("tail"), str):
+        return _extract_tail_queries(data["tail"])
+    raise SystemExit(f"bench_gate: unrecognized sweep format: {path}")
+
+
+def newest_artifact() -> str:
+    """The newest BENCH_r<k>.json in the repo root; falls back to
+    BENCH_DETAIL.json when it carries per-query records."""
+    rounds = []
+    for name in os.listdir(REPO):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if m:
+            rounds.append((int(m.group(1)), name))
+    detail = os.path.join(REPO, "BENCH_DETAIL.json")
+    if os.path.exists(detail):
+        try:
+            if load_queries(detail):
+                # prefer the detail blob only when it is NEWER than every
+                # round artifact (bench.py rewrites it each run)
+                if not rounds or os.path.getmtime(detail) >= max(
+                        os.path.getmtime(os.path.join(REPO, n))
+                        for _, n in rounds):
+                    return detail
+        except SystemExit:
+            pass
+    if not rounds:
+        raise SystemExit("bench_gate: no BENCH_r*.json / BENCH_DETAIL.json "
+                         "candidate found (pass a path)")
+    return os.path.join(REPO, max(rounds)[1])
+
+
+def compare(base: dict, cand: dict, warm_tol: float, abs_slack: float,
+            counter_tol: float) -> tuple[list, list]:
+    """-> (failures, notes). Only queries present on BOTH sides gate;
+    missing ones are notes (partial sweeps are a budget fact of life)."""
+    failures: list = []
+    notes: list = []
+    common = sorted(set(base) & set(cand))
+    for q in sorted(set(base) - set(cand)):
+        notes.append(f"{q}: in baseline but not in candidate (not gated)")
+    if not common:
+        failures.append("no overlapping queries between baseline and "
+                        "candidate — nothing was actually gated")
+        return failures, notes
+    for q in common:
+        b, c = base[q], cand[q]
+        bw, cw = float(b["warm_med_s"]), float(c["warm_med_s"])
+        limit = bw * warm_tol + abs_slack
+        if cw > limit:
+            failures.append(
+                f"{q}: warm {cw:.4f}s exceeds {limit:.4f}s "
+                f"(baseline {bw:.4f}s x{warm_tol} + {abs_slack}s); "
+                f"{cw / bw:.2f}x the baseline")
+        else:
+            notes.append(f"{q}: warm {cw:.4f}s vs baseline {bw:.4f}s "
+                         f"({cw / bw:.2f}x) ok")
+        bc, cc = b.get("counters") or {}, c.get("counters") or {}
+        for key in WATCH_COUNTERS:
+            if key not in bc or key not in cc:
+                continue
+            bv, cv = int(bc[key]), int(cc[key])
+            if cv > bv * counter_tol + COUNTER_ABS_SLACK:
+                failures.append(
+                    f"{q}: counter {key} {cv} vs baseline {bv} "
+                    f"(tolerance x{counter_tol} + {COUNTER_ABS_SLACK}) — "
+                    "explains-a-regression drift")
+    return failures, notes
+
+
+def write_baseline(src: str, dst: str) -> None:
+    qs = load_queries(src)
+    if not qs:
+        raise SystemExit(f"bench_gate: no per-query records in {src}")
+    out = {
+        "source": os.path.basename(src),
+        "warm_tol": DEFAULT_WARM_TOL,
+        "abs_slack_s": DEFAULT_ABS_SLACK_S,
+        "counter_tol": DEFAULT_COUNTER_TOL,
+        "queries": {q: {k: v for k, v in rec.items()
+                        if k in ("warm_med_s", "cold_s", "rows_per_s",
+                                 "counters", "grace", "packed")}
+                    for q, rec in sorted(qs.items())},
+    }
+    with open(dst, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"bench_gate: baseline ({len(qs)} queries) written to {dst}")
+
+
+def selftest(baseline_path: str) -> int:
+    with open(baseline_path) as f:
+        base_file = json.load(f)
+    base = base_file["queries"]
+    tol = (float(base_file.get("warm_tol", DEFAULT_WARM_TOL)),
+           float(base_file.get("abs_slack_s", DEFAULT_ABS_SLACK_S)),
+           float(base_file.get("counter_tol", DEFAULT_COUNTER_TOL)))
+    clean_f, _ = compare(base, base, *tol)
+    if clean_f:
+        print("bench_gate selftest: baseline-vs-itself FAILED (must pass):")
+        print("\n".join(f"  {x}" for x in clean_f))
+        return 1
+    doctored = {q: dict(rec, warm_med_s=float(rec["warm_med_s"]) * 3 + 1.0)
+                for q, rec in base.items()}
+    doct_f, _ = compare(base, doctored, *tol)
+    if not doct_f:
+        print("bench_gate selftest: 3x-doctored sweep PASSED (must fail)")
+        return 1
+    print(f"bench_gate selftest: OK (clean passes; doctored sweep trips "
+          f"{len(doct_f)} regressions)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_gate.py")
+    ap.add_argument("candidate", nargs="?", default=None,
+                    help="sweep JSON to gate (default: newest BENCH_r*/"
+                         "BENCH_DETAIL artifact)")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT)
+    ap.add_argument("--warm-tol", type=float, default=None)
+    ap.add_argument("--abs-slack", type=float, default=None)
+    ap.add_argument("--counter-tol", type=float, default=None)
+    ap.add_argument("--selftest", action="store_true",
+                    help="prove the gate trips on a doctored sweep")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="cut a new baseline from the candidate")
+    ap.add_argument("--run-sweep", action="store_true",
+                    help="run `python bench.py` first, gate its "
+                         "BENCH_DETAIL.json")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest(args.baseline)
+
+    if args.run_sweep:
+        import subprocess
+        rc = subprocess.call([sys.executable, os.path.join(REPO, "bench.py")],
+                             cwd=REPO)
+        if rc != 0:
+            print(f"bench_gate: bench.py exited {rc}")
+            return rc
+        args.candidate = os.path.join(REPO, "BENCH_DETAIL.json")
+
+    cand_path = args.candidate or newest_artifact()
+    if args.write_baseline:
+        write_baseline(cand_path, args.baseline)
+        return 0
+
+    with open(args.baseline) as f:
+        base_file = json.load(f)
+    warm_tol = args.warm_tol if args.warm_tol is not None else \
+        float(base_file.get("warm_tol", DEFAULT_WARM_TOL))
+    abs_slack = args.abs_slack if args.abs_slack is not None else \
+        float(base_file.get("abs_slack_s", DEFAULT_ABS_SLACK_S))
+    counter_tol = args.counter_tol if args.counter_tol is not None else \
+        float(base_file.get("counter_tol", DEFAULT_COUNTER_TOL))
+
+    cand = load_queries(cand_path)
+    print(f"bench_gate: {os.path.basename(cand_path)} vs "
+          f"{os.path.basename(args.baseline)} "
+          f"(warm x{warm_tol} + {abs_slack}s, counters x{counter_tol})")
+    failures, notes = compare(base_file["queries"], cand, warm_tol,
+                              abs_slack, counter_tol)
+    for n in notes:
+        print(f"  {n}")
+    if failures:
+        print(f"bench_gate: {len(failures)} REGRESSION(S):")
+        for x in failures:
+            print(f"  !! {x}")
+        return 1
+    print("bench_gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,6 +18,13 @@ fi
 echo "== 2-worker shuffle-join smoke (fragment-tier exchange) =="
 python scripts/shuffle_smoke.py
 
+echo "== trace smoke (flight recorder: stitched 2-worker Perfetto trace) =="
+python scripts/trace_smoke.py
+
+echo "== bench gate (perf regression vs committed baseline) =="
+python scripts/bench_gate.py --selftest
+python scripts/bench_gate.py
+
 echo "== two-level smoke (2 workers x 2 devices: mesh tier inside the exchange) =="
 python scripts/twolevel_smoke.py
 

@@ -1,0 +1,19 @@
+"""span-names MUST-FLAG fixture (checked against span_catalog.md):
+undocumented literal, undocumented request-scope name, uncovered f-string
+prefix. BAD markers sit on the line ABOVE each offending call."""
+from igloo_tpu.utils import flight_recorder, tracing
+
+
+def run(trace, phase):
+    # BAD: undocumented literal span name
+    with tracing.span("fixture.undocumented"):
+        pass
+    # BAD: request-scope name not in the catalog
+    with flight_recorder.request_scope(trace, "fixture.nope"):
+        pass
+    # BAD: no fixture.other.* wildcard in the catalog
+    with tracing.span(f"fixture.other.{phase}"):
+        pass
+    # documented, fine:
+    with tracing.span("fixture.step"):
+        pass

@@ -1,0 +1,14 @@
+"""span-names MUST-PASS fixture: every name covered by span_catalog.md."""
+import time
+
+from igloo_tpu.utils import flight_recorder, tracing
+
+
+def run(trace, phase):
+    with tracing.span("fixture.step", phase=phase):
+        pass
+    with flight_recorder.request_scope(trace, "fixture.request"):
+        pass
+    trace.add_span("fixture.added", time.time(), time.time())
+    with tracing.span(f"fixture.dyn.{phase}"):
+        pass

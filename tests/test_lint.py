@@ -11,6 +11,7 @@ from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
 from igloo_tpu.lint.metric_names import MetricNamesChecker
 from igloo_tpu.lint.pallas_dispatch import PallasDispatchChecker
 from igloo_tpu.lint.rpc_policy import RpcPolicyChecker
+from igloo_tpu.lint.span_names import SpanNamesChecker
 from igloo_tpu.lint.sync_hazard import SyncHazardChecker
 
 FIXTURES = Path(__file__).parent / "lint_fixtures"
@@ -160,6 +161,34 @@ def test_metric_names_flags_bad_fixture():
 
 def test_metric_names_passes_clean_fixture():
     assert _lint([PKG / "metric_clean.py"], [_metric_checker()]) == []
+
+
+# --- span-names -------------------------------------------------------------
+
+def _span_checker():
+    return SpanNamesChecker(doc_path=FIXTURES / "span_catalog.md")
+
+
+def test_span_names_flags_bad_fixture():
+    f = _lint([PKG / "span_bad.py"], [_span_checker()])
+    lines = {x.line for x in f}
+    src = (PKG / "span_bad.py").read_text().splitlines()
+    bad_lines = {i + 1 for i, ln in enumerate(src, 1)
+                 if ln.strip().startswith("# BAD")}
+    assert lines == bad_lines, (sorted(lines), sorted(bad_lines))
+
+
+def test_span_names_passes_clean_fixture():
+    assert _lint([PKG / "span_clean.py"], [_span_checker()]) == []
+
+
+def test_span_names_real_catalog_covers_the_tree():
+    """The real docs/observability.md span catalog must cover every span
+    call site in the package (the wired-in validate.sh gate)."""
+    findings, _w = run_lint(paths=list(iter_package_files()),
+                            checkers=[SpanNamesChecker()])
+    ours = [f for f in findings if f.rule == "span-names"]
+    assert ours == [], [f.render() for f in ours]
 
 
 # --- framework --------------------------------------------------------------
