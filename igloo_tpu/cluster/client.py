@@ -20,7 +20,7 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.flight as flight
 
-from igloo_tpu.cluster import rpc, serving
+from igloo_tpu.cluster import protocol, rpc, serving
 from igloo_tpu.cluster.rpc import call_options as _call_options
 from igloo_tpu.cluster.rpc import normalize as _normalize
 from igloo_tpu.errors import IglooError
@@ -43,11 +43,43 @@ class DistributedClient:
 
     def last_metrics(self) -> dict:
         """Per-fragment metrics of the last distributed query (worker, rows,
-        elapsed_s per fragment + totals)."""
-        return self._action("last_metrics")
+        elapsed_s per fragment + totals), typed through the registry schema
+        (cluster/protocol.py LAST_METRICS)."""
+        return protocol.LAST_METRICS.parse(self._action("last_metrics"))
 
     def tables(self) -> list[str]:
         return self.cluster_status()["tables"]
+
+    def active_queries(self) -> list[str]:
+        """qids of in-flight distributed queries (cancel targets)."""
+        return self._action("active_queries").get("queries", [])
+
+    def serving_status(self) -> dict:
+        """Admission queue / concurrency / HBM-reservation snapshot
+        (docs/serving.md; shape: cluster/protocol.py SERVING_STATUS)."""
+        return self._action("serving_status")
+
+    def trace(self, trace_id: Optional[str] = None,
+              qid: Optional[str] = None, fmt: str = "chrome") -> dict:
+        """Stitched flight-recorder timeline by trace_id or qid (neither =
+        the most recent query): Chrome-trace/Perfetto JSON by default,
+        the raw span record with fmt="raw"
+        (docs/observability.md#distributed-tracing)."""
+        return self._action("trace", protocol.TRACE_REQUEST.build(
+            trace_id=trace_id, qid=qid, format=fmt))
+
+    def metrics_text(self) -> str:
+        """Coordinator process + worker-aggregated fragment metrics,
+        Prometheus text exposition."""
+        return rpc.flight_action_raw(
+            self.addr, "metrics",
+            policy=self._policy).decode()
+
+    def poll_info(self, sql: str) -> dict:
+        """PollFlightInfo equivalent: planning completes eagerly, so the
+        reply is always {"progress": 1.0, "complete": true}."""
+        return self._action("poll_flight_info",
+                            protocol.POLL_FLIGHT_INFO.build(sql=sql))
 
     # --- queries ---
 
@@ -74,22 +106,15 @@ class DistributedClient:
         fatal errors (the query itself failed) surface immediately.
         Retrying from scratch is safe: results materialize via read_all(),
         so no partial batches were consumed."""
-        ticket = sql
-        if deadline_s is not None or qid is not None \
-                or priority is not None or session is not None \
-                or trace_id is not None:
-            body: dict = {"sql": sql}
-            if deadline_s is not None:
-                body["deadline_s"] = deadline_s
-            if qid is not None:
-                body["qid"] = qid
-            if priority is not None:
-                body["priority"] = priority
-            if session is not None:
-                body["session"] = session
-            if trace_id is not None:
-                body["trace_id"] = trace_id
-            ticket = json.dumps(body)
+        # the registry coerces HERE, so a mistyped field fails client-side
+        # with a ProtocolError naming it instead of round-tripping to an
+        # opaque server error; unset fields are omitted and a bare ticket
+        # collapses to the SQL itself (stock-client wire compatibility)
+        body = protocol.QUERY_TICKET.build(sql=sql, deadline_s=deadline_s,
+                                           qid=qid, priority=priority,
+                                           session=session,
+                                           trace_id=trace_id)
+        ticket = protocol.encode_query_ticket(body, sql)
         timeout = self._policy.stream_timeout_s if deadline_s is None \
             else deadline_s + min(5.0, self._policy.connect_timeout_s)
         if busy_wait_s is None:
@@ -132,8 +157,9 @@ class DistributedClient:
     def cancel(self, qid: str) -> bool:
         """Cancel a running distributed query by the qid passed to
         `execute`; False when the coordinator no longer knows it."""
-        return bool(self._action("cancel_query",
-                                 {"qid": qid}).get("cancelled"))
+        return bool(self._action(
+            "cancel_query",
+            protocol.CANCEL_QUERY.build(qid=qid)).get("cancelled"))
 
     def schema(self, sql: str) -> pa.Schema:
         """Result schema WITHOUT executing (the reference runs the query to
@@ -158,15 +184,15 @@ class DistributedClient:
         writer.close()
 
     def register_parquet(self, name: str, path: str) -> None:
-        self._action("register_table",
-                     {"name": name, "spec": {"kind": "parquet", "path": path}})
+        self._action("register_table", protocol.REGISTER_TABLE.build(
+            name=name, spec={"kind": "parquet", "path": path}))
 
     def register_csv(self, name: str, path: str, has_header: bool = True,
                      delimiter: str = ",") -> None:
-        self._action("register_table",
-                     {"name": name, "spec": {"kind": "csv", "path": path,
-                                             "has_header": has_header,
-                                             "delimiter": delimiter}})
+        self._action("register_table", protocol.REGISTER_TABLE.build(
+            name=name, spec={"kind": "csv", "path": path,
+                             "has_header": has_header,
+                             "delimiter": delimiter}))
 
     # --- plumbing ---
 

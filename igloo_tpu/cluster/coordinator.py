@@ -37,7 +37,7 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.flight as flight
 
-from igloo_tpu.cluster import faults, rpc, serde, serving
+from igloo_tpu.cluster import faults, protocol, rpc, serde, serving
 from igloo_tpu.cluster.fragment import DistributedPlanner, QueryFragment
 from igloo_tpu.cluster.rpc import flight_action
 from igloo_tpu.engine import QueryEngine
@@ -630,14 +630,13 @@ class DistributedExecutor:
         # running server-side, and end-of-query release must reach this addr
         # even after _recover reassigns the fragment elsewhere
         metrics["_addrs"].add(f.worker)
-        req = {"id": f.id, "plan": f.plan,
-               "deps": [{"id": d, "addr": completed[d]} for d in f.deps]}
+        deps = [protocol.DISPATCH_DEP.build(id=d, addr=completed[d])
+                for d in f.deps]
         rem = rpc.remaining_s(deadline)
-        if rem is not None:
-            # ship the remaining budget as a RELATIVE bound (clocks differ
-            # across machines): the worker uses it to deadline its own peer
-            # dep-fetches so a hung peer can't wedge the fragment either
-            req["timeout_s"] = round(max(rem, 0.001), 3)
+        # ship the remaining budget as a RELATIVE bound (clocks differ
+        # across machines): the worker uses it to deadline its own peer
+        # dep-fetches so a hung peer can't wedge the fragment either
+        timeout_s = round(max(rem, 0.001), 3) if rem is not None else None
         pol = self._policy()
         # flight-recorder: the dispatch span's id ships INSIDE the request
         # as the worker-side parent, so the worker's span tree re-parents
@@ -649,9 +648,16 @@ class DistributedExecutor:
         try:
             t0 = time.perf_counter()
             with span_cm as span_id:
-                if span_id is not None:
-                    req["trace"] = {"trace_id": tr.trace_id,
-                                    "parent_id": span_id}
+                # the dispatch payload, typed through the registry; the
+                # trace block ships the dispatch span's id as the worker-
+                # side parent so the worker's tree stitches under this RPC
+                ctx = protocol.TRACE_CTX.build(
+                    trace_id=tr.trace_id, parent_id=span_id) \
+                    if span_id is not None else None
+                req = protocol.DISPATCH.build(id=f.id, plan=f.plan,
+                                              deps=deps,
+                                              timeout_s=timeout_s,
+                                              trace=ctx)
                 # retries=0: re-dispatch is the RECOVERY layer's job — an
                 # RPC-level retry against the same hung worker would just
                 # double the time a dead worker stalls the wave. The
@@ -668,6 +674,9 @@ class DistributedExecutor:
                                                 if deadline is not None
                                                 else pol.stream_timeout_s))
             wall = time.perf_counter() - t0
+            # typed through the registry: a worker answering with a
+            # malformed stats report fails loudly here, naming the field
+            info = protocol.FRAGMENT_STATS.parse(info)
             if tr is not None:
                 # stitch the worker's span tree into the query trace (and
                 # keep the metrics fragments lean — spans are trace data)
@@ -817,7 +826,8 @@ class DistributedExecutor:
             try:
                 # short bound, no retries: release is best-effort cleanup and
                 # often targets the very worker that just died
-                flight_action(addr, "release", {"ids": ids},
+                flight_action(addr, "release",
+                              protocol.RELEASE.build(ids=ids),
                               policy=self._policy().with_(retries=0),
                               timeout_s=10.0)
             except Exception:
@@ -903,7 +913,8 @@ class CoordinatorServer(flight.FlightServerBase):
                     w.tables_pushed.discard(name.lower())
 
     def _push_table(self, w: WorkerState, name: str, spec: dict) -> None:
-        flight_action(w.addr, "register_table", {"name": name, "spec": spec})
+        flight_action(w.addr, "register_table",
+                      protocol.REGISTER_TABLE.build(name=name, spec=spec))
         w.tables_pushed.add(name.lower())
 
     def _sync_worker_tables(self, w: WorkerState) -> None:
@@ -1198,7 +1209,7 @@ class CoordinatorServer(flight.FlightServerBase):
         body = action.body.to_pybytes() if action.body is not None else b""
         req = json.loads(body) if body else {}
         if action.type == "cancel_query":
-            ok = self.executor.cancel(req.get("qid", ""))
+            ok = self.executor.cancel(protocol.CANCEL_QUERY.parse(req)["qid"])
             return [json.dumps({"cancelled": ok}).encode()]
         if action.type == "active_queries":
             return [json.dumps(
@@ -1208,7 +1219,7 @@ class CoordinatorServer(flight.FlightServerBase):
             self.membership.register(info["id"], info["addr"],
                                      devices=info["devices"],
                                      slots=info["slots"])
-            w = self.membership.by_addr(req["addr"])
+            w = self.membership.by_addr(info["addr"])
             if w is not None:
                 try:
                     self._sync_worker_tables(w)
@@ -1230,14 +1241,15 @@ class CoordinatorServer(flight.FlightServerBase):
             # raw entry bytes by XLA cache filename (NOT JSON — workers use
             # rpc.flight_action_raw); empty body = no such entry
             from igloo_tpu import compile_cache
-            data = compile_cache.read_entry(req.get("name", ""))
+            data = compile_cache.read_entry(
+                protocol.COMPILE_CACHE_GET.parse(req)["name"])
             return [data if data is not None else b""]
         if action.type == "compile_cache_put":
             # worker pushing a freshly compiled entry back to the cluster
             from igloo_tpu import compile_cache
+            put = protocol.COMPILE_CACHE_PUT.parse(req)
             stored = compile_cache.write_entry(
-                req.get("name", ""),
-                compile_cache.decode_entry(req.get("data", "")))
+                put["name"], compile_cache.decode_entry(put["data"]))
             return [json.dumps({"stored": stored}).encode()]
         if action.type == "heartbeat":
             info = serde.worker_info_from_json(req)
@@ -1249,8 +1261,9 @@ class CoordinatorServer(flight.FlightServerBase):
                 slots=info["slots"])
             return [json.dumps({"ok": ok}).encode()]
         if action.type == "register_table":
-            provider = serde.provider_from_spec(req["spec"])
-            self.register_table(req["name"], provider)
+            rt = protocol.REGISTER_TABLE.parse(req)
+            provider = serde.provider_from_spec(rt["spec"])
+            self.register_table(rt["name"], provider)
             return [b"{}"]
         if action.type == "cluster_status":
             return [json.dumps({
@@ -1266,12 +1279,12 @@ class CoordinatorServer(flight.FlightServerBase):
             # stitched query timeline by trace_id or qid (neither = most
             # recent); Chrome-trace/Perfetto JSON by default, the raw span
             # record with {"format": "raw"} (raw bytes — flight_action_raw)
-            rec = flight_recorder.get_record(req.get("trace_id"),
-                                             req.get("qid"))
+            tq = protocol.TRACE_REQUEST.parse(req)
+            rec = flight_recorder.get_record(tq["trace_id"], tq["qid"])
             if rec is None:
                 raise flight.FlightServerError(
-                    f"no such trace: {req.get('trace_id') or req.get('qid') or '<last>'}")
-            if req.get("format") == "raw":
+                    f"no such trace: {tq['trace_id'] or tq['qid'] or '<last>'}")
+            if tq["format"] == "raw":
                 return [json.dumps(rec).encode()]
             return [json.dumps(flight_recorder.to_chrome_trace(rec)).encode()]
         if action.type == "serving_status":
@@ -1292,36 +1305,16 @@ class CoordinatorServer(flight.FlightServerBase):
         if action.type == "poll_flight_info":
             # body: JSON {"sql": "..."} (do_action parses all bodies as JSON)
             info = self.get_flight_info(
-                context, flight.FlightDescriptor.for_command(req["sql"]))
+                context, flight.FlightDescriptor.for_command(
+                    protocol.POLL_FLIGHT_INFO.parse(req)["sql"]))
             return [json.dumps({"progress": 1.0, "complete": True}).encode(),
                     info.serialize()]
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
-        return [("cancel_query", "cancel a running distributed query by qid"),
-                ("active_queries", "qids of in-flight distributed queries"),
-                ("register_worker", "worker membership registration "
-                                    "(returns compile-cache setting + "
-                                    "entry listing for pre-warm)"),
-                ("compile_cache_get",
-                 "persistent-compile-cache entry bytes by filename"),
-                ("compile_cache_put",
-                 "store a worker-compiled persistent-cache entry"),
-                ("heartbeat", "worker liveness heartbeat"),
-                ("register_table", "register a table from a provider spec"),
-                ("cluster_status", "membership + catalog snapshot"),
-                ("last_metrics", "per-fragment metrics of the last query"),
-                ("trace", "stitched query timeline by trace_id/qid as "
-                          "Chrome-trace/Perfetto JSON (format=raw for the "
-                          "span record)"),
-                ("serving_status",
-                 "admission queue / concurrency / HBM-reservation snapshot"),
-                ("metrics", "process + worker-aggregated fragment metrics, "
-                            "Prometheus text format"),
-                ("ping", "liveness"),
-                ("poll_flight_info",
-                 "PollFlightInfo equivalent: serialized FlightInfo for a "
-                 "SQL command, progress=1.0 (planning completes eagerly)")]
+        # straight from the registry: the flight-actions checker holds this
+        # surface and do_action's dispatch to the same declaration
+        return protocol.action_doc("coordinator")
 
     def get_flight_info(self, context, descriptor):
         sql = self._descriptor_sql(descriptor)
@@ -1338,35 +1331,19 @@ class CoordinatorServer(flight.FlightServerBase):
     def do_get(self, context, ticket):
         faults.inject("coordinator.do_get")
         raw = ticket.ticket.decode()
-        sql, deadline_s, qid = raw, None, None
-        priority, session, trace_id = 1, "", None
-        if raw.lstrip().startswith("{"):
-            # extended ticket: {"sql": ..., "deadline_s": ..., "qid": ...,
-            # "priority": ..., "session": ..., "trace_id": ...}
-            # (SQL cannot start with "{", so plain-SQL tickets keep working)
-            try:
-                d = json.loads(raw)
-                sql = d["sql"]
-                if not isinstance(sql, str):
-                    raise TypeError("sql must be a string")
-                deadline_s = d.get("deadline_s")
-                if deadline_s is not None:
-                    # coerce HERE so a mistyped field ("5" or [5]) is a
-                    # "bad query ticket" error, not a TypeError surfacing
-                    # as an opaque gRPC internal error mid-execute
-                    deadline_s = float(deadline_s)
-                qid = d.get("qid")
-                if qid is not None:
-                    qid = str(qid)
-                priority = int(d.get("priority", 1))
-                session = str(d.get("session", ""))
-                # client-chosen trace identity: lets a caller correlate its
-                # own telemetry with the server-side stitched timeline
-                trace_id = d.get("trace_id")
-                if trace_id is not None:
-                    trace_id = str(trace_id)
-            except (ValueError, KeyError, TypeError):
-                raise flight.FlightServerError(f"bad query ticket: {raw!r}")
+        try:
+            # the registry coerces every extended-ticket field HERE, so a
+            # mistyped field ("5" for deadline_s, [5] for priority) is a
+            # "bad query ticket" error naming the field, not a TypeError
+            # surfacing as an opaque gRPC internal error mid-execute
+            t = protocol.parse_query_ticket(raw)
+        except protocol.ProtocolError as ex:
+            raise flight.FlightServerError(f"bad query ticket: {ex}")
+        sql, deadline_s, qid = t["sql"], t["deadline_s"], t["qid"]
+        # trace_id is the client-chosen trace identity: lets a caller
+        # correlate its own telemetry with the stitched server timeline
+        priority, session = t["priority"], t["session"]
+        trace_id = t["trace_id"]
         trace = None
         if flight_recorder.enabled():
             trace = flight_recorder.Trace(trace_id=trace_id, qid=qid or "",

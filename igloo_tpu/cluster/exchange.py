@@ -35,6 +35,7 @@ from typing import Iterator, Optional
 import numpy as np
 import pyarrow as pa
 
+from igloo_tpu.cluster import protocol
 from igloo_tpu.utils import tracing
 
 _GOLDEN = np.uint64(0x9E3779B97F4A7C15)
@@ -192,17 +193,17 @@ def salted_partition(table: pa.Table, key_indices: list[int], nbuckets: int,
 
 def make_ticket(frag_id: str, bucket: Optional[int] = None,
                 nbuckets: Optional[int] = None) -> bytes:
+    """Encode through the registry (cluster/protocol.py EXCHANGE_TICKET); a
+    whole-fragment request stays the bare id so stock clients keep working."""
     if bucket is None:
         return frag_id.encode()
-    return json.dumps({"frag": frag_id, "bucket": bucket,
-                       "nbuckets": nbuckets}).encode()
+    return json.dumps(protocol.EXCHANGE_TICKET.build(
+        frag=frag_id, bucket=bucket, nbuckets=nbuckets)).encode()
 
 
 def parse_ticket(raw: bytes) -> tuple[str, Optional[int], Optional[int]]:
-    if raw.startswith(b"{"):
-        d = json.loads(raw.decode())
-        return d["frag"], d.get("bucket"), d.get("nbuckets")
-    return raw.decode(), None, None
+    t = protocol.parse_exchange_ticket(raw)
+    return t["frag"], t["bucket"], t["nbuckets"]
 
 
 # --- the bytes-budgeted fragment store --------------------------------------

@@ -1,0 +1,462 @@
+"""The cluster's wire contracts, declared ONCE.
+
+Every cross-process payload the fragment tier exchanges — the extended JSON
+do_get ticket, the worker do_get exchange ticket, the execute_fragment
+dispatch request (with its dependency refs and trace block), the
+registration/heartbeat worker_info, the per-fragment stats report, the
+last_metrics shape, and the small control-action payloads — is declared here
+as a `Message` of typed `Field`s, and both Flight action surfaces
+(coordinator + worker) are declared as literal name tables. Producers call
+``MSG.build(...)`` and consumers call ``MSG.parse(...)``, so typed coercion,
+defaults, required-field enforcement, and the unknown-field policy live in
+ONE place instead of ~44 raw string literals scattered across six modules.
+
+Why: protocol drift is this repo's costliest bug class — fused-vs-staged
+overflow tag keys diverged (PR 10), legacy heartbeat payloads silently reset
+topology (PR 11), and a mistyped do_get ticket field surfaced as an opaque
+mid-execute TypeError (PR 7). A mistyped field is now a `ProtocolError`
+naming the message and field at the wire boundary, and the igloo-lint
+``wire-contract`` / ``flight-actions`` checkers statically cross-check every
+build/parse site in the package against these declarations
+(docs/static_analysis.md).
+
+This module is deliberately AST-friendly: the registry assignments below are
+PURE LITERALS (``Message("name", [Field(...), ...])`` and dict/list
+constants), because the lint checkers extract them by parsing this file —
+never importing it. Keep computed values out of the declarations.
+
+Versioning rule: decode with tolerance (unknown fields ride through by
+default, optional fields take declared defaults — an old single-device
+worker_info still parses), encode strictly (a producer setting an undeclared
+field is a hard error — that is how a new field is FORCED through this
+registry instead of drifting in as a raw literal).
+"""
+from __future__ import annotations
+
+import json
+
+from igloo_tpu.errors import IglooError
+
+
+class ProtocolError(IglooError):
+    """A wire payload violated its declared contract (missing required
+    field, uncoercible value, undeclared field at a build site)."""
+
+
+class Field:
+    """One declared wire field: name, coercion type, required/optional, and
+    the default consumers see when an optional field is absent.
+
+    `type` is one of str/int/float/bool/dict/list (coercion target) or None
+    (pass through untyped — reserved for values the registry cannot
+    meaningfully coerce, like plan trees that serde owns). `strict` skips
+    coercion: the value must already BE the declared type (the SQL text of a
+    ticket is strict — an int "coerced" to SQL would fail confusingly deep
+    in the parser instead of at the wire)."""
+
+    __slots__ = ("name", "type", "required", "default", "strict", "doc")
+
+    def __init__(self, name: str, type=None, required: bool = False,
+                 default=None, strict: bool = False, doc: str = ""):
+        self.name = name
+        self.type = type
+        self.required = required
+        self.default = default
+        self.strict = strict
+        self.doc = doc
+
+    def coerce(self, value, message: str):
+        if value is None or self.type is None:
+            return value
+        t = self.type
+        try:
+            if self.strict:
+                if not isinstance(value, t) or \
+                        (t is not bool and isinstance(value, bool)):
+                    raise TypeError
+                return value
+            if t is bool:
+                if isinstance(value, bool):
+                    return value
+                if isinstance(value, int):
+                    return bool(value)
+                raise TypeError
+            if t in (int, float, str):
+                if isinstance(value, (dict, list, tuple)):
+                    raise TypeError
+                return t(value)
+            if t is dict:
+                if not isinstance(value, dict):
+                    raise TypeError
+                return value
+            if t is list:
+                if isinstance(value, tuple):
+                    return list(value)
+                if not isinstance(value, list):
+                    raise TypeError
+                return value
+            return value
+        except (TypeError, ValueError):
+            raise ProtocolError(
+                f"bad {message} field {self.name!r}: expected "
+                f"{t.__name__}, got {type_name(value)} ({value!r})") from None
+
+
+def type_name(value) -> str:
+    return type(value).__name__
+
+
+class Message:
+    """One cross-process contract: a named set of `Field`s plus policy.
+
+    - ``check``: "flow" messages get the wire-contract checker's full
+      produced/consumed cross-module analysis; "schema" messages are typed
+      schema (build/parse still coerce and validate) without flow
+      obligations — used for report shapes whose fields fan out into
+      internal bookkeeping dicts.
+    - ``unknown``: what `parse` does with undeclared keys — "keep" (version
+      tolerance: a newer peer's extra fields ride through) or "drop".
+    - ``fill``: whether `parse` materializes absent optional fields with
+      their declared defaults (True for request shapes so consumers never
+      `.get`-with-default again; False for sparse report shapes where an
+      absent key must stay absent).
+    """
+
+    __slots__ = ("name", "fields", "check", "unknown", "fill", "doc")
+
+    def __init__(self, name: str, fields: list, check: str = "flow",
+                 unknown: str = "keep", fill: bool = True, doc: str = ""):
+        self.name = name
+        self.fields = {f.name: f for f in fields}
+        self.check = check
+        self.unknown = unknown
+        self.fill = fill
+        self.doc = doc
+
+    def build(self, **values) -> dict:
+        """Producer side: typed dict ready for json.dumps. `None` for an
+        optional field means "not set" and is omitted; an undeclared keyword
+        is a hard error (new fields must be declared here first)."""
+        out: dict = {}
+        for name, value in values.items():
+            f = self.fields.get(name)
+            if f is None:
+                raise ProtocolError(
+                    f"undeclared field {name!r} built for message "
+                    f"{self.name!r} — declare it in cluster/protocol.py")
+            if value is None:
+                if f.required:
+                    raise ProtocolError(
+                        f"bad {self.name}: required field {name!r} is None")
+                continue
+            out[name] = f.coerce(value, self.name)
+        for name, f in self.fields.items():
+            if f.required and name not in out:
+                raise ProtocolError(
+                    f"bad {self.name}: missing required field {name!r}")
+        return out
+
+    def parse(self, raw) -> dict:
+        """Consumer side: accepts a dict (or JSON str/bytes), returns a
+        coerced dict with required fields enforced and (when `fill`) absent
+        optional fields defaulted. Unknown keys follow the declared policy."""
+        if isinstance(raw, (bytes, bytearray)):
+            raw = raw.decode()
+        if isinstance(raw, str):
+            try:
+                raw = json.loads(raw)
+            except ValueError as ex:
+                raise ProtocolError(
+                    f"bad {self.name}: not valid JSON ({ex})") from None
+        if not isinstance(raw, dict):
+            raise ProtocolError(
+                f"bad {self.name}: expected a JSON object, got "
+                f"{type_name(raw)}")
+        out: dict = {}
+        for name, f in self.fields.items():
+            # an explicit JSON null is "not set", NOT a value: for a
+            # required field that is a missing-field error at the wire —
+            # letting {"sql": null} through would resurrect the opaque
+            # mid-execute NoneType crash this registry exists to kill
+            if raw.get(name) is not None:
+                out[name] = f.coerce(raw[name], self.name)
+            elif f.required:
+                raise ProtocolError(
+                    f"bad {self.name}: missing required field {name!r}")
+            elif self.fill:
+                default = f.default
+                if isinstance(default, (list, dict)):
+                    # fresh copy per parse: a consumer mutating a defaulted
+                    # container must not contaminate later requests
+                    default = type(default)(default)
+                out[name] = default
+        if self.unknown == "keep":
+            for k, v in raw.items():
+                if k not in self.fields:
+                    out[k] = v
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The registry. PURE LITERALS ONLY — the lint checkers parse, never import.
+# ---------------------------------------------------------------------------
+
+#: extended do_get ticket the client sends the coordinator. A bare-SQL
+#: ticket stays supported (SQL cannot start with "{"); `parse_query_ticket`
+#: below folds both forms into this message.
+QUERY_TICKET = Message("query_ticket", [
+    Field("sql", str, required=True, strict=True, doc="the query"),
+    Field("deadline_s", float,
+          doc="server-enforced query budget; 0 = already spent"),
+    Field("qid", str, doc="name for cancel_query / active_queries"),
+    Field("priority", int, default=1,
+          doc="admission tier (0 = interactive; docs/serving.md)"),
+    Field("session", str, default="",
+          doc="session id for the per-session in-flight cap"),
+    Field("trace_id", str,
+          doc="client-chosen flight-recorder trace identity"),
+], doc="client -> coordinator do_get")
+
+#: worker do_get ticket addressing a fragment result or one bucket slice.
+#: A bare `<frag_id>` ticket addresses the whole result;
+#: `parse_exchange_ticket` folds both forms into this message.
+EXCHANGE_TICKET = Message("exchange_ticket", [
+    Field("frag", str, required=True, doc="fragment id"),
+    Field("bucket", int, doc="bucket slice (None = whole result)"),
+    Field("nbuckets", int,
+          doc="expected partition count (mismatch = hard error)"),
+], doc="coordinator/worker -> worker do_get")
+
+#: the trace block riding inside a dispatch: stitches the worker's span
+#: tree under the coordinator's dispatch span (docs/observability.md).
+TRACE_CTX = Message("trace_ctx", [
+    Field("trace_id", str, required=True),
+    Field("parent_id", str, doc="coordinator-side dispatch span id"),
+], doc="coordinator -> worker, inside the dispatch payload")
+
+#: one upstream dependency reference inside a dispatch payload.
+DISPATCH_DEP = Message("dispatch_dep", [
+    Field("id", str, required=True, doc="dependency fragment id"),
+    Field("addr", str, default="", doc="worker holding its result"),
+], doc="coordinator -> worker, dispatch `deps` entries")
+
+#: the execute_fragment request.
+DISPATCH = Message("dispatch", [
+    Field("id", str, required=True, doc="fragment id"),
+    Field("plan", dict, required=True,
+          doc="serialized plan tree (cluster/serde.py owns the node schema)"),
+    Field("deps", list, default=[], doc="list of dispatch_dep"),
+    Field("timeout_s", float,
+          doc="query budget remaining, RELATIVE (clocks differ)"),
+    Field("trace", dict, doc="trace_ctx block, when tracing"),
+], doc="coordinator -> worker execute_fragment action")
+
+#: registration/heartbeat payload. Version tolerance is the point: a worker
+#: predating the topology fields registers as single-device, which keeps the
+#: planner's sizing exactly as it was before two-level parallelism. (The
+#: pre-PR14 heartbeat also shipped a `ts` wall-clock field no consumer ever
+#: read — the coordinator's last_seen is its OWN clock, cross-host clocks
+#: don't compare — so the wire-contract checker retired it; old payloads
+#: carrying it still parse, the key just rides through unread.)
+WORKER_INFO = Message("worker_info", [
+    Field("id", str, required=True, doc="worker id (uuid hex)"),
+    Field("addr", str, default="", doc="advertised Flight address"),
+    Field("devices", int, default=1,
+          doc="local mesh size one fragment runs across"),
+    Field("slots", int, default=0, doc="execution-slot bound"),
+], doc="worker -> coordinator register_worker/heartbeat actions")
+
+#: per-fragment stats the worker returns from execute_fragment — the shape
+#: last_metrics["fragments"] entries start from, before the coordinator's
+#: enrichment fields (declared below too, so the whole row is one schema).
+#: `fill=False`: absent keys stay absent (bucket fields only exist for
+#: Exchange-rooted fragments), and transfer/compile deltas may be None.
+FRAGMENT_STATS = Message("fragment_stats", [
+    Field("id", str, required=True),
+    Field("rows", int, required=True, doc="result rows"),
+    Field("elapsed_s", float, required=True, doc="execution wall"),
+    Field("worker", str, doc="executing worker id"),
+    Field("dep_fetch_s", float, doc="dependency-fetch wall"),
+    Field("input_rows", int, doc="rows fetched from dependencies"),
+    Field("mesh_devices", int, doc="chips the fragment ran across"),
+    Field("mesh_rows_per_device", int),
+    Field("result_bytes", int, doc="Arrow bytes of the stored result"),
+    Field("h2d_bytes", int), Field("d2h_bytes", int),
+    Field("jit_misses", int), Field("cache_hits", int),
+    Field("exchange_rows", int), Field("exchange_bytes", int),
+    Field("buckets", int, doc="partition count (Exchange roots only)"),
+    Field("bucket_rows", list, doc="UNSALTED per-bucket rows (skew sketch)"),
+    Field("salted", bool, doc="salted exchange layout"),
+    Field("spans", list, doc="worker span tree for trace stitching"),
+    # coordinator-side enrichment (never on the wire; part of the published
+    # last_metrics fragment rows):
+    Field("addr", str, doc="[coordinator] dispatch target"),
+    Field("kind", str, doc="[coordinator] planner fragment kind"),
+    Field("bucket", int, doc="[coordinator] shuffle bucket id"),
+    Field("stats_key", str, doc="[coordinator] adaptive side digest"),
+    Field("dispatch_s", float, doc="[coordinator] RPC wall minus worker"),
+], check="schema", fill=False,
+    doc="worker -> coordinator execute_fragment response")
+
+#: the published per-query metrics dict (`last_metrics` action, mirrored
+#: into system.query_log columns) — docs/distributed.md#telemetry.
+LAST_METRICS = Message("last_metrics", [
+    Field("qid", str),
+    Field("status", str, doc="ok|cancelled|deadline_exceeded|error|shed"),
+    Field("fragments", list, doc="fragment_stats rows"),
+    Field("recoveries", int), Field("recover_s", float),
+    Field("fetch_s", float), Field("deadline_s", float),
+    Field("cancelled", bool), Field("deadline_exceeded", bool),
+    Field("trace_id", str), Field("shuffle_buckets", int),
+    Field("adaptive", list, doc="planner decision records"),
+    Field("queue_wait_s", float), Field("priority", int),
+    Field("demoted", int),
+    Field("topology", dict, doc="{workers, devices, total_shards}"),
+    Field("total_rows", int), Field("rows", int),
+    Field("exchange_bytes", int), Field("execution_time_s", float),
+    Field("result_cache_hit", bool),
+], check="schema", fill=False, doc="coordinator last_metrics action reply")
+
+#: serving_status action reply (docs/serving.md).
+SERVING_STATUS = Message("serving_status", [
+    Field("enabled", bool), Field("queue_depth", int),
+    Field("max_concurrency", int), Field("session_inflight", int),
+    Field("hbm_budget_bytes", int), Field("weights", list),
+    Field("running", int), Field("hbm_reserved_bytes", int),
+    Field("queued", dict, doc="priority tier -> queued count"),
+    Field("sessions", dict, doc="session -> in-flight count"),
+], check="schema", doc="coordinator serving_status action reply")
+
+# --- small control-action payloads -----------------------------------------
+
+CANCEL_QUERY = Message("cancel_query", [
+    Field("qid", str, default="", doc="qid passed to execute"),
+], doc="client -> coordinator cancel_query action")
+
+REGISTER_TABLE = Message("register_table", [
+    Field("name", str, required=True),
+    Field("spec", dict, required=True,
+          doc="provider spec (cluster/serde.py owns the kinds)"),
+], doc="client/coordinator -> coordinator/worker register_table action")
+
+COMPILE_CACHE_GET = Message("compile_cache_get", [
+    Field("name", str, default="", doc="XLA cache entry filename"),
+], doc="worker -> coordinator compile_cache_get action")
+
+COMPILE_CACHE_PUT = Message("compile_cache_put", [
+    Field("name", str, default=""),
+    Field("data", str, default="", doc="base64 entry bytes"),
+], doc="worker -> coordinator compile_cache_put action")
+
+RELEASE = Message("release", [
+    Field("ids", list, default=[], doc="fragment ids to drop"),
+], doc="coordinator -> worker release action")
+
+TRACE_REQUEST = Message("trace_request", [
+    Field("trace_id", str), Field("qid", str),
+    Field("format", str, default="chrome", doc="chrome | raw"),
+], doc="client -> coordinator trace action")
+
+POLL_FLIGHT_INFO = Message("poll_flight_info", [
+    Field("sql", str, required=True),
+], doc="client -> coordinator poll_flight_info action")
+
+
+# --- Flight action-name tables ----------------------------------------------
+# The flight-actions checker cross-checks each server's do_action dispatch
+# AND its list_actions against these, and every flight_action*/_action call
+# site in the package against their union.
+
+COORDINATOR_ACTIONS = {
+    "cancel_query": "cancel a running distributed query by qid",
+    "active_queries": "qids of in-flight distributed queries",
+    "register_worker": "worker membership registration (returns "
+                       "compile-cache setting + entry listing for pre-warm)",
+    "compile_cache_get": "persistent-compile-cache entry bytes by filename",
+    "compile_cache_put": "store a worker-compiled persistent-cache entry",
+    "heartbeat": "worker liveness heartbeat",
+    "register_table": "register a table from a provider spec",
+    "cluster_status": "membership + catalog snapshot",
+    "last_metrics": "per-fragment metrics of the last query",
+    "trace": "stitched query timeline by trace_id/qid as Chrome-trace/"
+             "Perfetto JSON (format=raw for the span record)",
+    "serving_status": "admission queue / concurrency / HBM-reservation "
+                      "snapshot",
+    "metrics": "process + worker-aggregated fragment metrics, Prometheus "
+               "text format",
+    "ping": "liveness",
+    "poll_flight_info": "PollFlightInfo equivalent: serialized FlightInfo "
+                        "for a SQL command, progress=1.0 (planning "
+                        "completes eagerly)",
+}
+
+WORKER_ACTIONS = {
+    "execute_fragment": "execute a serialized plan fragment",
+    "register_table": "register a table from a provider spec",
+    "release": "drop cached fragment results",
+    "ping": "liveness + status",
+    "metrics": "process metrics, Prometheus text format",
+}
+
+#: which module serves which action table (the flight-actions checker reads
+#: these paths; any OTHER module defining do_action is held to the union).
+ACTION_SERVERS = {
+    "coordinator": "igloo_tpu/cluster/coordinator.py",
+    "worker": "igloo_tpu/cluster/worker.py",
+}
+
+#: the modules where wire payloads are produced/consumed — the scope of the
+#: wire-contract checker's raw-field-access rule (a json.loads'd payload
+#: subscripted with a flow-message field name here must go through parse).
+WIRE_MODULES = [
+    "igloo_tpu/cluster/client.py",
+    "igloo_tpu/cluster/coordinator.py",
+    "igloo_tpu/cluster/exchange.py",
+    "igloo_tpu/cluster/serde.py",
+    "igloo_tpu/cluster/serving.py",
+    "igloo_tpu/cluster/worker.py",
+]
+
+#: module-level helpers below that parse a message (the wire-contract
+#: checker tags their call sites as consumers of the mapped message).
+PARSE_HELPERS = {
+    "parse_query_ticket": "query_ticket",
+    "parse_exchange_ticket": "exchange_ticket",
+}
+
+
+# --- ticket folding helpers --------------------------------------------------
+
+
+def parse_query_ticket(raw: str) -> dict:
+    """Decode a coordinator do_get ticket: the extended JSON form, or a bare
+    SQL string (SQL cannot start with "{", so plain tickets keep working).
+    Raises ProtocolError naming the offending field — the caller maps it to
+    a "bad query ticket" Flight error instead of an opaque mid-execute
+    TypeError (the PR 7 bug class)."""
+    if raw.lstrip().startswith("{"):
+        return QUERY_TICKET.parse(raw)
+    return QUERY_TICKET.parse({"sql": raw})
+
+
+def encode_query_ticket(body: dict, sql: str) -> str:
+    """The client-side inverse: a built query_ticket collapses to the bare
+    SQL when no extended field is set (stock-client wire compatibility)."""
+    return sql if list(body) == ["sql"] else json.dumps(body)
+
+
+def parse_exchange_ticket(raw: bytes) -> dict:
+    """Decode a worker do_get ticket: the bucketed JSON form, or a bare
+    fragment id (fragment ids are hex, never "{"-prefixed)."""
+    if isinstance(raw, (bytes, bytearray)):
+        raw = raw.decode()
+    if raw.lstrip().startswith("{"):
+        return EXCHANGE_TICKET.parse(raw)
+    return EXCHANGE_TICKET.parse({"frag": raw})
+
+
+def action_doc(server: str) -> list:
+    """(name, description) pairs for a server's list_actions, straight from
+    the registry (declaration order)."""
+    table = COORDINATOR_ACTIONS if server == "coordinator" else WORKER_ACTIONS
+    return list(table.items())

@@ -17,6 +17,7 @@ from typing import Optional
 import pyarrow as pa
 
 from igloo_tpu import types as T
+from igloo_tpu.cluster import protocol
 from igloo_tpu.errors import PlanError
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
@@ -305,26 +306,29 @@ def _rx(j, catalog) -> Optional[E.Expr]:
 
 
 def worker_info_to_json(worker_id: str, addr: str, devices: int = 1,
-                        slots: int = 0, ts: Optional[float] = None) -> dict:
-    """The registration/heartbeat payload, in ONE place for both sides of the
-    wire: `devices` is the size of the worker's LOCAL mesh (1 = single-device)
-    — the topology number the distributed planner sizes bucket counts and
-    placement with (bucket count scales with hosts, shard count with chips,
-    docs/distributed.md) — and `slots` its execution-slot bound."""
-    d = {"id": worker_id, "addr": addr, "devices": int(max(devices, 1)),
-         "slots": int(slots)}
-    if ts is not None:
-        d["ts"] = ts
-    return d
+                        slots: int = 0) -> dict:
+    """The registration/heartbeat payload, built through the protocol
+    registry (cluster/protocol.py WORKER_INFO) so both sides of the wire
+    share one declaration: `devices` is the size of the worker's LOCAL mesh
+    (1 = single-device) — the topology number the distributed planner sizes
+    bucket counts and placement with (bucket count scales with hosts, shard
+    count with chips, docs/distributed.md) — and `slots` its execution-slot
+    bound. (The pre-PR14 heartbeat also shipped a wall-clock `ts` no
+    consumer ever read; the wire-contract checker retired it.)"""
+    return protocol.WORKER_INFO.build(id=worker_id, addr=addr,
+                                      devices=int(max(devices, 1)),
+                                      slots=int(slots))
 
 
 def worker_info_from_json(d: dict) -> dict:
-    """Decode with version tolerance: a worker predating the topology fields
-    (or a hand-rolled client) registers as single-device, which keeps the
-    planner's sizing exactly as it was before two-level parallelism."""
-    return {"id": d["id"], "addr": d.get("addr", ""),
-            "devices": int(d.get("devices", 1) or 1),
-            "slots": int(d.get("slots", 0) or 0)}
+    """Decode with version tolerance (the registry's declared defaults): a
+    worker predating the topology fields — or a hand-rolled client —
+    registers as single-device, which keeps the planner's sizing exactly as
+    it was before two-level parallelism."""
+    info = protocol.WORKER_INFO.parse(d)
+    return {"id": info["id"], "addr": info["addr"],
+            "devices": int(info["devices"] or 1),
+            "slots": int(info["slots"] or 0)}
 
 
 # --- provider specs (how a worker re-creates a coordinator table) ---

@@ -401,21 +401,22 @@ class AdmissionController:
     # --- introspection -----------------------------------------------------
 
     def snapshot(self) -> dict:
-        """Queue/slot state for the coordinator's serving_status action."""
+        """Queue/slot state for the coordinator's serving_status action,
+        shaped by the registry (cluster/protocol.py SERVING_STATUS)."""
+        from igloo_tpu.cluster import protocol
         with self._cond:
-            return {
-                "enabled": self.enabled,
-                "queue_depth": self.queue_depth,
-                "max_concurrency": self.max_concurrency,
-                "session_inflight": self.session_inflight,
-                "hbm_budget_bytes": self.hbm_budget_bytes,
-                "weights": list(self.weights),
-                "running": self._running,
-                "hbm_reserved_bytes": self._reserved,
-                "queued": {str(p): len(q)
-                           for p, q in self._queues.items()},
-                "sessions": dict(self._sessions),
-            }
+            return protocol.SERVING_STATUS.build(
+                enabled=self.enabled,
+                queue_depth=self.queue_depth,
+                max_concurrency=self.max_concurrency,
+                session_inflight=self.session_inflight,
+                hbm_budget_bytes=self.hbm_budget_bytes,
+                weights=list(self.weights),
+                running=self._running,
+                hbm_reserved_bytes=self._reserved,
+                queued={str(p): len(q) for p, q in self._queues.items()},
+                sessions=dict(self._sessions),
+            )
 
 
 # --- footprint prediction -----------------------------------------------------

@@ -40,7 +40,7 @@ import pyarrow as pa
 import pyarrow.flight as flight
 
 from igloo_tpu.catalog import Catalog, MemTable
-from igloo_tpu.cluster import exchange, faults, serde
+from igloo_tpu.cluster import exchange, faults, protocol, serde
 from igloo_tpu.cluster.fragment import FRAG_PREFIX, _frag_refs
 from igloo_tpu.cluster import rpc
 from igloo_tpu.cluster.rpc import flight_action, flight_stream_batches
@@ -237,21 +237,19 @@ class WorkerServer(flight.FlightServerBase):
         self._store.put(dep_key, table)
         return table
 
-    def _execute_fragment(self, req: dict) -> dict:
-        frag_id = req["id"]
-        addr_of = {d["id"]: d["addr"] for d in req.get("deps", [])}
-        # the coordinator ships the query's remaining budget as a RELATIVE
-        # timeout (clocks differ across machines); anchor it here
-        deadline = None
-        if req.get("timeout_s") is not None:
-            deadline = time.time() + float(req["timeout_s"])
+    def _execute_fragment(self, frag_id: str, plan_json: dict,
+                          addr_of: dict, deadline: Optional[float]) -> dict:
+        """Execute one deserialized dispatch (protocol fields already parsed
+        out by `_handle_execute_fragment` — this method is wire-format-free):
+        resolve dependencies, run the plan, store the result, and return the
+        fragment_stats report."""
         overlay: dict = {}
         input_rows = 0
         # per-fragment counter delta: thread-isolated, so concurrent
         # fragments on this worker report only their own transfers/compiles
         with tracing.counter_delta() as delta:
             t_dep0 = time.perf_counter()
-            for ref in _frag_refs(req["plan"]):
+            for ref in _frag_refs(plan_json):
                 dep_id = ref["table"][len(FRAG_PREFIX):]
                 name = ref["table"].lower()
                 if name in overlay:
@@ -263,7 +261,7 @@ class WorkerServer(flight.FlightServerBase):
                 overlay[name] = MemTable(t)
             dep_s = time.perf_counter() - t_dep0
             catalog = _OverlayCatalog(self._catalog, overlay)
-            plan = serde.plan_from_json(req["plan"], catalog)
+            plan = serde.plan_from_json(plan_json, catalog)
             partition = salt = None
             if isinstance(plan, L.Exchange):
                 # fragment-root exchange: execute the input, hash-partition
@@ -292,21 +290,24 @@ class WorkerServer(flight.FlightServerBase):
         mesh_devices = int(getattr(ex, "n_dev", 1))
         if mesh_devices > 1:
             tracing.counter("mesh.sharded_fragments")
-        out = {"id": frag_id, "rows": table.num_rows,
-               "elapsed_s": round(elapsed, 6), "worker": self.worker_id,
-               "dep_fetch_s": round(dep_s, 6),
-               "input_rows": input_rows,
-               "mesh_devices": mesh_devices,
-               "mesh_rows_per_device": table.num_rows // mesh_devices,
-               # Arrow bytes of the stored result: the coordinator's
-               # adaptive recording sums these per join side
-               "result_bytes": ent.nbytes,
-               "h2d_bytes": delta.get("xfer.h2d_bytes"),
-               "d2h_bytes": delta.get("xfer.d2h_bytes"),
-               "jit_misses": delta.get("jit.miss"),
-               "cache_hits": delta.get("cache.hit"),
-               "exchange_rows": delta.get("exchange.fetch_rows"),
-               "exchange_bytes": delta.get("exchange.fetch_bytes")}
+        # the fragment_stats report, typed through the registry (None deltas
+        # are omitted on the wire — consumers read sparsely); result_bytes is
+        # the Arrow size of the stored result, which the coordinator's
+        # adaptive recording sums per join side
+        out = protocol.FRAGMENT_STATS.build(
+            id=frag_id, rows=table.num_rows,
+            elapsed_s=round(elapsed, 6), worker=self.worker_id,
+            dep_fetch_s=round(dep_s, 6),
+            input_rows=input_rows,
+            mesh_devices=mesh_devices,
+            mesh_rows_per_device=table.num_rows // mesh_devices,
+            result_bytes=ent.nbytes,
+            h2d_bytes=delta.get("xfer.h2d_bytes"),
+            d2h_bytes=delta.get("xfer.d2h_bytes"),
+            jit_misses=delta.get("jit.miss"),
+            cache_hits=delta.get("cache.hit"),
+            exchange_rows=delta.get("exchange.fetch_rows"),
+            exchange_bytes=delta.get("exchange.fetch_bytes"))
         if partition is not None:
             out["buckets"] = partition[1]
             # UNSALTED per-bucket rows: the coordinator's skew sketch must
@@ -318,63 +319,90 @@ class WorkerServer(flight.FlightServerBase):
 
     # --- Flight surface ---
 
+    def _handle_execute_fragment(self, req: dict) -> dict:
+        """The execute_fragment action body: parse the dispatch through the
+        registry (a malformed payload fails HERE, naming the field), wait
+        for an execution slot, run, and return the stats report. Every wire
+        field is plucked in this one method — `_execute_fragment` below is
+        wire-format-free."""
+        disp = protocol.DISPATCH.parse(req)
+        frag_id = disp["id"]
+        addr_of: dict = {}
+        for d in disp["deps"]:
+            dep = protocol.DISPATCH_DEP.parse(d)
+            addr_of[dep["id"]] = dep["addr"]
+        # the coordinator ships the query's remaining budget as a RELATIVE
+        # timeout (clocks differ across machines); anchor it here
+        timeout_s = disp["timeout_s"]
+        deadline = time.time() + timeout_s if timeout_s is not None else None
+        # flight-recorder: the dispatch request carries the query's
+        # trace context; this worker's span tree (rooted at a fresh
+        # request scope — span hygiene for the reused gRPC thread) rides
+        # back beside the fragment stats for the coordinator to stitch
+        ctx = None
+        if disp["trace"]:
+            ctx = protocol.TRACE_CTX.parse(disp["trace"])
+        trace = None
+        if ctx is not None and flight_recorder.enabled():
+            trace = flight_recorder.Trace(trace_id=ctx["trace_id"],
+                                          qid=frag_id)
+        with flight_recorder.request_scope(
+                trace, "execute_fragment",
+                proc=f"worker:{self.worker_id}",
+                parent_id=ctx["parent_id"] if ctx is not None else None,
+                frag=frag_id):
+            # slot bound: a saturated worker must answer with the
+            # WORKER_BUSY marker BEFORE the coordinator's dispatch RPC
+            # deadline concludes it is hung (call_timeout_s=120 under a
+            # query deadline, the stream bound without one) — so the
+            # wait is capped at half a short bound, never the fragment's
+            # full deadline. The coordinator REQUEUES a busy fragment
+            # without evicting us.
+            wait_s = min(timeout_s or 60.0, 60.0) / 2
+            t0 = time.perf_counter()
+            with tracing.span("worker.slot_wait") as sp:
+                ok = self._slots.acquire(timeout=max(wait_s, 0.001))
+                sp.attrs = {"acquired": ok}
+            if not ok:
+                tracing.counter("worker.slot_timeouts")
+                raise flight.FlightUnavailableError(
+                    f"WORKER_BUSY worker {self.worker_id}: all "
+                    f"{self.slots} execution slots busy")
+            tracing.gauge_add("worker.slots_busy", 1)
+            tracing.histogram("worker.slot_wait_s",
+                              time.perf_counter() - t0)
+            try:
+                out = self._execute_fragment(frag_id, disp["plan"], addr_of,
+                                             deadline)
+            except IglooError as ex:
+                raise flight.FlightServerError(f"fragment failed: {ex}")
+            finally:
+                tracing.gauge_add("worker.slots_busy", -1)
+                self._slots.release()
+        if trace is not None:
+            # read AFTER the scope exit — that is when the thread-local
+            # span tree flushes into the trace
+            out["spans"] = trace.spans()
+        return out
+
     def do_action(self, context, action):
         faults.inject(f"worker.do_action.{action.type}")
         body = action.body.to_pybytes() if action.body is not None else b""
         req = json.loads(body) if body else {}
         if action.type == "execute_fragment":
-            # flight-recorder: the dispatch request carries the query's
-            # trace context; this worker's span tree (rooted at a fresh
-            # request scope — span hygiene for the reused gRPC thread) rides
-            # back beside the fragment stats for the coordinator to stitch
-            ctx = req.get("trace") or {}
-            trace = None
-            if ctx.get("trace_id") and flight_recorder.enabled():
-                trace = flight_recorder.Trace(trace_id=ctx["trace_id"],
-                                              qid=str(req.get("id", "")))
-            with flight_recorder.request_scope(
-                    trace, "execute_fragment",
-                    proc=f"worker:{self.worker_id}",
-                    parent_id=ctx.get("parent_id"), frag=req.get("id", "")):
-                # slot bound: a saturated worker must answer with the
-                # WORKER_BUSY marker BEFORE the coordinator's dispatch RPC
-                # deadline concludes it is hung (call_timeout_s=120 under a
-                # query deadline, the stream bound without one) — so the
-                # wait is capped at half a short bound, never the fragment's
-                # full deadline. The coordinator REQUEUES a busy fragment
-                # without evicting us.
-                wait_s = min(float(req.get("timeout_s") or 60.0), 60.0) / 2
-                t0 = time.perf_counter()
-                with tracing.span("worker.slot_wait") as sp:
-                    ok = self._slots.acquire(timeout=max(wait_s, 0.001))
-                    sp.attrs = {"acquired": ok}
-                if not ok:
-                    tracing.counter("worker.slot_timeouts")
-                    raise flight.FlightUnavailableError(
-                        f"WORKER_BUSY worker {self.worker_id}: all "
-                        f"{self.slots} execution slots busy")
-                tracing.gauge_add("worker.slots_busy", 1)
-                tracing.histogram("worker.slot_wait_s",
-                                  time.perf_counter() - t0)
-                try:
-                    out = self._execute_fragment(req)
-                except IglooError as ex:
-                    raise flight.FlightServerError(f"fragment failed: {ex}")
-                finally:
-                    tracing.gauge_add("worker.slots_busy", -1)
-                    self._slots.release()
-            if trace is not None:
-                # read AFTER the scope exit — that is when the thread-local
-                # span tree flushes into the trace
-                out["spans"] = trace.spans()
+            try:
+                out = self._handle_execute_fragment(req)
+            except protocol.ProtocolError as ex:
+                raise flight.FlightServerError(f"bad dispatch payload: {ex}")
             return [json.dumps(out).encode()]
         if action.type == "register_table":
-            provider = serde.provider_from_spec(req["spec"])
-            self._catalog.register(req["name"], provider)
-            self._batch_cache.invalidate_table(req["name"].lower())
+            rt = protocol.REGISTER_TABLE.parse(req)
+            provider = serde.provider_from_spec(rt["spec"])
+            self._catalog.register(rt["name"], provider)
+            self._batch_cache.invalidate_table(rt["name"].lower())
             return [b"{}"]
         if action.type == "release":
-            ids = req.get("ids", [])
+            ids = protocol.RELEASE.parse(req)["ids"]
             deps = [k for k in self._store.ids()
                     if any(k.startswith(_dep_key(fid, None)) for fid in ids)]
             self._store.release(ids + deps)
@@ -393,15 +421,16 @@ class WorkerServer(flight.FlightServerBase):
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
-        return [("execute_fragment", "execute a serialized plan fragment"),
-                ("register_table", "register a table from a provider spec"),
-                ("release", "drop cached fragment results"),
-                ("ping", "liveness + status"),
-                ("metrics", "process metrics, Prometheus text format")]
+        # straight from the registry: the flight-actions checker holds this
+        # surface and do_action's dispatch to the same declaration
+        return protocol.action_doc("worker")
 
     def do_get(self, context, ticket):
         faults.inject("worker.do_get")
-        frag_id, bucket, nbuckets = exchange.parse_ticket(ticket.ticket)
+        try:
+            frag_id, bucket, nbuckets = exchange.parse_ticket(ticket.ticket)
+        except protocol.ProtocolError as ex:
+            raise flight.FlightServerError(f"bad exchange ticket: {ex}")
         try:
             schema, batches = self._store.stream(frag_id, bucket, nbuckets)
         except KeyError:
@@ -555,7 +584,8 @@ class Worker:
             # a connect/teardown per entry would dominate the transfer
             pulls = rpc.flight_actions_raw(
                 self.coordinator,
-                (("compile_cache_get", {"name": n}) for n in missing))
+                (("compile_cache_get", protocol.COMPILE_CACHE_GET.build(
+                    name=n)) for n in missing))
             for name, data in zip(missing, pulls):
                 done += 1
                 if data and compile_cache.write_entry(name, data):
@@ -568,7 +598,8 @@ class Worker:
             for name in missing[done:]:
                 try:
                     data = rpc.flight_action_raw(
-                        self.coordinator, "compile_cache_get", {"name": name})
+                        self.coordinator, "compile_cache_get",
+                        protocol.COMPILE_CACHE_GET.build(name=name))
                     if data and compile_cache.write_entry(name, data):
                         tracing.counter("compile_cache.pull")
                 except Exception:
@@ -601,8 +632,8 @@ class Worker:
                 if data is None:
                     continue
                 attempted.append(name)
-                yield ("compile_cache_put", {
-                    "name": name, "data": compile_cache.encode_entry(data)})
+                yield ("compile_cache_put", protocol.COMPILE_CACHE_PUT.build(
+                    name=name, data=compile_cache.encode_entry(data)))
 
         confirmed = 0
         try:
@@ -649,7 +680,7 @@ class Worker:
                     serde.worker_info_to_json(
                         self.server.worker_id, self.server.advertise,
                         devices=self.server.mesh_devices,
-                        slots=self.server.slots, ts=time.time()))
+                        slots=self.server.slots))
                 if not resp.get("ok", True):
                     self._register()
                     tracing.counter("worker.reregistrations")

@@ -40,11 +40,33 @@ visitors (docs/static_analysis.md has the rule catalog):
                       ``exec/dispatch.py`` — every Pallas kernel call must
                       run under the dispatch layer (IGLOO_TPU_PALLAS flag,
                       eligibility checks, overflow fallback ladder), or the
-                      kill switch stops being trustworthy.
+                      kill switch stops being trustworthy;
+- ``wire-contract``   whole-program protocol conformance against the
+                      declarative registry in ``cluster/protocol.py``: every
+                      registry-tagged ``build``/``parse`` site's fields must
+                      be declared, flow-checked message fields must be both
+                      produced AND consumed somewhere in the package, and
+                      raw json field plucking in the wire modules is flagged
+                      (the PR 7/10/11 protocol-drift bug class);
+- ``flight-actions``  action strings dispatched in server ``do_action``
+                      methods and passed to ``flight_action*`` helpers must
+                      match the registry's action tables exactly, both
+                      directions;
+- ``env-knobs``       every ``IGLOO_*`` env knob read in the package must
+                      have a row in the consolidated ``docs/knobs.md``
+                      catalog with a matching default, every catalog row
+                      must have a live reader, and ``[rpc]``/``[serving]``
+                      config keys must agree with their documented env twin.
+
+The last three are the framework's first WHOLE-PROGRAM rules: they subclass
+``TwoPassChecker`` (collect per-file summaries, then judge globally), an API
+sync-hazard and jit-key can adopt for cross-module reasoning later.
 
 Suppress a finding with a trailing ``# lint: allow(<rule>)`` comment on the
 offending line (or a standalone allow-comment on the line directly above);
 every suppression should say why on the same line or the surrounding code.
+``python -m igloo_tpu.lint --stale-allows`` reports allow-comments that no
+longer suppress anything, so dead suppressions don't linger as false cover.
 
 Entry point: ``python -m igloo_tpu.lint`` (wired into scripts/validate.sh
 and the __graft_entry__ dryrun preamble). Pure AST — no imports of the
@@ -125,6 +147,41 @@ class Checker:
         return ()
 
 
+class TwoPassChecker(Checker):
+    """Whole-program rule family: pass 1 `collect`s a per-file summary (plus
+    any immediately-judgeable findings), pass 2 `judge`s the summaries
+    globally once every module has been seen. The framework routes `check`
+    into collect and `finalize` into judge, so two-pass checkers run under
+    the same driver (and the same allow-comment filtering) as per-file ones.
+
+    `judge` findings land wherever the checker anchors them — a registry
+    declaration line, a docs-catalog row — and are allow-filterable only
+    when that file is among the linted modules (run_lint's by_path rule)."""
+
+    def __init__(self):
+        self._summaries: dict = {}   # relpath -> summary object
+
+    def collect(self, mod: LintModule):
+        """-> (summary, findings) for one module."""
+        return None, ()
+
+    def judge(self, summaries: dict) -> Iterable[Finding]:
+        """Global pass over every module's summary."""
+        return ()
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        summary, findings = self.collect(mod)
+        self._summaries[mod.relpath] = summary
+        return findings
+
+    def finalize(self, modules: list) -> Iterable[Finding]:
+        # one run's summaries must not leak into the next: a reused checker
+        # instance (whole-package run followed by a single-file run) would
+        # otherwise judge the second run against the first run's files
+        summaries, self._summaries = self._summaries, {}
+        return self.judge(summaries)
+
+
 def dotted(node: ast.AST) -> Optional[str]:
     """'jnp.sum' / 'jax.lax.scan' / 'self._lock' for Name/Attribute chains;
     None for anything else (calls, subscripts)."""
@@ -138,6 +195,12 @@ def dotted(node: ast.AST) -> Optional[str]:
     return None
 
 
+def const_str(node) -> Optional[str]:
+    """The value of a string-literal AST node, else None."""
+    return node.value if isinstance(node, ast.Constant) and \
+        isinstance(node.value, str) else None
+
+
 def iter_package_files(root: Path = PACKAGE_ROOT) -> list[Path]:
     """Every package source file except lint/ itself (the linter's own regex
     literals and rule tables would self-match)."""
@@ -149,6 +212,8 @@ def iter_package_files(root: Path = PACKAGE_ROOT) -> list[Path]:
 
 def default_checkers() -> list:
     from igloo_tpu.lint.cache_key import CacheKeyChecker
+    from igloo_tpu.lint.env_knobs import EnvKnobsChecker
+    from igloo_tpu.lint.flight_actions import FlightActionsChecker
     from igloo_tpu.lint.jit_key import JitKeyChecker
     from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
     from igloo_tpu.lint.metric_names import MetricNamesChecker
@@ -156,9 +221,24 @@ def default_checkers() -> list:
     from igloo_tpu.lint.rpc_policy import RpcPolicyChecker
     from igloo_tpu.lint.span_names import SpanNamesChecker
     from igloo_tpu.lint.sync_hazard import SyncHazardChecker
+    from igloo_tpu.lint.wire_contract import WireContractChecker
     return [SyncHazardChecker(), CacheKeyChecker(), JitKeyChecker(),
             LockDisciplineChecker(), MetricNamesChecker(),
-            SpanNamesChecker(), RpcPolicyChecker(), PallasDispatchChecker()]
+            SpanNamesChecker(), RpcPolicyChecker(), PallasDispatchChecker(),
+            WireContractChecker(), FlightActionsChecker(),
+            EnvKnobsChecker()]
+
+
+def _raw_lint(modules: list, checkers: list) -> tuple[list, list]:
+    """Every finding, SUPPRESSIONS INCLUDED, plus warnings."""
+    findings: list[Finding] = []
+    warnings: list[str] = []
+    for c in checkers:
+        for mod in modules:
+            findings.extend(c.check(mod))
+        findings.extend(c.finalize(modules))
+        warnings.extend(getattr(c, "warnings", ()))
+    return findings, warnings
 
 
 def run_lint(paths: Optional[list] = None, checkers: Optional[list] = None,
@@ -172,20 +252,66 @@ def run_lint(paths: Optional[list] = None, checkers: Optional[list] = None,
         checkers = [c for c in checkers if c.name in select]
     files = paths if paths is not None else iter_package_files()
     modules = [LintModule.parse(Path(p), root=root) for p in files]
-    findings: list[Finding] = []
-    warnings: list[str] = []
     by_path = {m.relpath: m for m in modules}
-    for c in checkers:
-        got: list[Finding] = []
-        for mod in modules:
-            for f in c.check(mod):
-                if not mod.allowed(f.rule, f.line):
-                    got.append(f)
-        for f in c.finalize(modules):
-            m = by_path.get(f.path)
-            if m is None or not m.allowed(f.rule, f.line):
-                got.append(f)
-        warnings.extend(getattr(c, "warnings", ()))
-        findings.extend(got)
+    raw, warnings = _raw_lint(modules, checkers)
+    findings = []
+    for f in raw:
+        m = by_path.get(f.path)
+        if m is None or not m.allowed(f.rule, f.line):
+            findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, warnings
+
+
+def stale_allows(paths: Optional[list] = None,
+                 checkers: Optional[list] = None,
+                 root: Path = REPO_ROOT) -> list:
+    """Report mode for ``--stale-allows``: every ``# lint: allow(<rule>)``
+    comment that no longer suppresses any finding — the rule was fixed, the
+    code moved, or the rule name was always wrong. Returns Findings (rule
+    ``stale-allow``) so the CLI renders them like everything else. A stale
+    allow is dead weight at best and false cover at worst: the next REAL
+    finding on that line would be silently swallowed."""
+    if checkers is None:
+        checkers = default_checkers()
+    files = paths if paths is not None else iter_package_files()
+    modules = [LintModule.parse(Path(p), root=root) for p in files]
+    raw, _warnings = _raw_lint(modules, checkers)
+    hit: set = set()              # (relpath, line, rule) actually suppressed
+    for f in raw:
+        hit.add((f.path, f.line, f.rule))
+    known_rules = {c.name for c in checkers}
+    # on a PARTIAL run the whole-program rules gate their global pass off,
+    # so an allow suppressing one of their findings would look stale here
+    # and its removal would break the full run — skip those rules' allows
+    pkg = {p.resolve().relative_to(Path(root).resolve()).as_posix()
+           for p in iter_package_files()
+           if Path(root).resolve() in p.resolve().parents}
+    partial = not pkg or not pkg <= {m.relpath for m in modules}
+    unjudgeable = {c.name for c in checkers
+                   if partial and isinstance(c, TwoPassChecker)}
+    out: list[Finding] = []
+    for m in modules:
+        # reconstruct each allow COMMENT from the text (mod.allows smears a
+        # standalone comment over two lines; report the comment's own line)
+        for i, line in enumerate(m.text.splitlines(), start=1):
+            match = _ALLOW_RE.search(line)
+            if not match:
+                continue
+            rules = {r.strip() for r in match.group(1).split(",")
+                     if r.strip()}
+            covered = {i, i + 1} if line.lstrip().startswith("#") else {i}
+            for rule in sorted(rules):
+                if rule not in known_rules:
+                    out.append(Finding(
+                        "stale-allow", m.relpath, i,
+                        f"allow({rule}) names no known rule"))
+                elif rule in unjudgeable:
+                    continue  # global pass gated off: cannot judge here
+                elif not any((m.relpath, ln, rule) in hit
+                             for ln in covered):
+                    out.append(Finding(
+                        "stale-allow", m.relpath, i,
+                        f"allow({rule}) suppresses nothing — remove it"))
+    out.sort(key=lambda f: (f.path, f.line))
+    return out

@@ -23,6 +23,9 @@ def main(argv=None) -> int:
                     help="comma-separated rule names to run "
                          "(default: all)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--stale-allows", action="store_true",
+                    help="report `# lint: allow(<rule>)` comments that no "
+                         "longer suppress any finding")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress warnings and the OK summary")
     args = ap.parse_args(argv)
@@ -58,6 +61,24 @@ def main(argv=None) -> int:
                                     if "__pycache__" not in q.parts))
             else:
                 paths.append(p)
+
+    if args.stale_allows:
+        if select:
+            print("igloo-lint: --stale-allows runs every rule (an allow "
+                  "for an unselected rule would look stale); drop --select",
+                  file=sys.stderr)
+            return 2
+        from igloo_tpu.lint import stale_allows
+        stale = stale_allows(paths=paths, checkers=checkers)
+        for f in stale:
+            print(f.render())
+        if stale:
+            print(f"igloo-lint: {len(stale)} stale allow-comment"
+                  f"{'s' if len(stale) != 1 else ''}", file=sys.stderr)
+            return 1
+        if not args.quiet:
+            print("igloo-lint: no stale allows")
+        return 0
 
     t0 = time.perf_counter()
     findings, warnings = run_lint(paths=paths, checkers=checkers,

@@ -1,0 +1,446 @@
+"""env-knobs: every IGLOO_* env knob is cataloged in docs/knobs.md, with
+matching defaults and config twins.
+
+The engine grew ~40 ``IGLOO_*`` environment knobs across the exec, cluster,
+serving, and observability layers, each documented (or not) wherever it was
+born. This checker makes ``docs/knobs.md`` the single catalog and holds both
+sides to it:
+
+- every env read of an ``IGLOO_*`` name in the package (``os.environ.get`` /
+  ``os.getenv`` / ``os.environ[...]`` / presence checks / the serving
+  ``_env_int`` helper / rpc's paired ``(field, env)`` table) must have a
+  catalog row — an undocumented knob is a finding at the read site;
+- a catalog row whose knob no code reads is a STALE row (finding at the doc
+  line; whole-package runs only);
+- when a read site carries an extractable literal default (two-arg ``get``,
+  helper default argument, paired-dataclass field default — simple constant
+  folding of ``1 << 30``-style expressions included), it must equal the
+  catalog's default column, and every site must agree with every other —
+  default drift between code and doc (or site and site) is a finding. Rows
+  whose default the code derives dynamically document it as ``unset`` or
+  prose and are not cross-checked.
+- config twins: a row's ``[section] key`` twin must name a real field of the
+  matching config dataclass (igloo_tpu/config.py), and every ``[rpc]`` /
+  ``[serving]`` dataclass field must appear as some row's twin — the
+  env-var/TOML pairing cannot silently diverge.
+
+Whole-program by nature: subclass of the two-pass checker API.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable, Optional
+
+from igloo_tpu.lint import (
+    REPO_ROOT, Finding, LintModule, TwoPassChecker, const_str, dotted,
+    iter_package_files,
+)
+
+RULE = "env-knobs"
+
+DEFAULT_DOC = REPO_ROOT / "docs" / "knobs.md"
+DEFAULT_CONFIG = REPO_ROOT / "igloo_tpu" / "config.py"
+
+_KNOB_RE = re.compile(r"^IGLOO_[A-Z0-9_]+$")
+_DOC_KNOB_RE = re.compile(r"`(IGLOO_[A-Z0-9_]+)`")
+_TWIN_RE = re.compile(r"`\[(\w+)\]\s+(\w+)`")
+
+#: helper functions that read env by name: name arg index, default arg index
+_HELPER_SPECS = {"_env_int": (0, 2)}
+
+#: twin section -> config.py dataclass holding its keys
+_SECTION_CLASSES = {"rpc": "RpcConfig", "serving": "ServingConfig",
+                    "cluster": "ClusterConfig",
+                    "distributed": "DistributedConfig", "engine": "Config"}
+
+#: config sections whose every field must have a documented env twin
+_TWINNED_SECTIONS = ("rpc", "serving")
+
+#: marker for "read with no inline default" (derived/unset)
+_NO_DEFAULT = object()
+
+
+def _const_eval(node, consts: dict):
+    """Tiny constant folder for default expressions: literals, module
+    constants, +,-,*,//,<<,** on folded values, str()/int()/float() of one
+    folded value. Returns _NO_DEFAULT when unresolvable."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id, _NO_DEFAULT)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_eval(node.operand, consts)
+        return -v if isinstance(v, (int, float)) else _NO_DEFAULT
+    if isinstance(node, ast.BinOp):
+        left = _const_eval(node.left, consts)
+        right = _const_eval(node.right, consts)
+        if not isinstance(left, (int, float)) or \
+                not isinstance(right, (int, float)):
+            return _NO_DEFAULT
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv):
+                return left // right
+            if isinstance(node.op, ast.LShift):
+                return left << right
+            if isinstance(node.op, ast.Pow):
+                return left ** right
+        except Exception:
+            return _NO_DEFAULT
+        return _NO_DEFAULT
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and \
+            node.func.id in ("str", "int", "float") and len(node.args) == 1:
+        v = _const_eval(node.args[0], consts)
+        if v is _NO_DEFAULT:
+            return _NO_DEFAULT
+        try:
+            return {"str": str, "int": int, "float": float}[node.func.id](v)
+        except Exception:
+            return _NO_DEFAULT
+    return _NO_DEFAULT
+
+
+def _canon(value) -> str:
+    """Canonical string form of a default for doc comparison."""
+    if value is True:
+        return "true"
+    if value is False:
+        return "false"
+    return str(value)
+
+
+def _same_default(a: str, b: str) -> bool:
+    if a == b:
+        return True
+    try:
+        return float(a) == float(b)
+    except (TypeError, ValueError):
+        return False
+
+
+class _Summary:
+    def __init__(self):
+        # knob -> [(default-or-_NO_DEFAULT, line), ...]
+        self.reads: dict = {}
+    def add(self, knob: str, default, line: int) -> None:
+        self.reads.setdefault(knob, []).append((default, line))
+
+
+class EnvKnobsChecker(TwoPassChecker):
+    name = RULE
+
+    #: overridable for fixture tests
+    doc_path: Optional[Path] = None
+    config_path: Optional[Path] = None
+    #: None = require a whole-package run for the doc-side checks;
+    #: True forces them (fixture tests)
+    full: Optional[bool] = None
+
+    def __init__(self, doc_path: Optional[Path] = None,
+                 config_path: Optional[Path] = None,
+                 full: Optional[bool] = None):
+        super().__init__()
+        if doc_path is not None:
+            self.doc_path = Path(doc_path)
+        if config_path is not None:
+            self.config_path = Path(config_path)
+        if full is not None:
+            self.full = full
+        self.warnings: list = []
+
+    # --- pass 1 -----------------------------------------------------------
+
+    def collect(self, mod: LintModule):
+        consts = self._module_consts(mod.tree)
+        params = self._function_params(mod.tree)
+        s = _Summary()
+        self._collect_env_reads(mod, s, consts, params)
+        self._collect_paired_tables(mod.tree, s, consts)
+        return s, ()
+
+    def _module_consts(self, tree: ast.Module) -> dict:
+        """Module- and class-level NAME = <literal> constants."""
+        consts: dict = {}
+        def scan(body):
+            for node in body:
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Constant):
+                    consts[node.targets[0].id] = node.value.value
+                elif isinstance(node, ast.ClassDef):
+                    scan(node.body)
+        scan(tree.body)
+        return consts
+
+    def _function_params(self, tree: ast.Module) -> set:
+        """Names that legitimately carry an env-var name dynamically:
+        function parameters and loop/comprehension targets (helper functions
+        and table-driven reads like rpc.policy_from_env)."""
+        out: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                    out.add(arg.arg)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                target = node.target
+                for t in ast.walk(target):
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _resolve_name(self, node, consts: dict) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            v = consts.get(node.id)
+            return v if isinstance(v, str) else None
+        if isinstance(node, ast.Attribute):        # self.REGISTER_TIMEOUT_ENV
+            v = consts.get(node.attr)
+            return v if isinstance(v, str) else None
+        return None
+
+    def _collect_env_reads(self, mod, s: _Summary, consts: dict,
+                           params: set) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                tail = d.split(".")[-2:]
+                helper = _HELPER_SPECS.get(d.split(".")[-1])
+                if tail[-2:] == ["environ", "get"] or \
+                        d.split(".")[-1] == "getenv" or \
+                        (len(tail) == 2 and
+                         tail == ["environ", "setdefault"]):
+                    if not node.args:
+                        continue
+                    self._record_read(
+                        mod, s, node.args[0],
+                        node.args[1] if len(node.args) > 1 else None,
+                        node.lineno, consts, params)
+                elif helper is not None:
+                    nidx, didx = helper
+                    if len(node.args) > nidx:
+                        self._record_read(
+                            mod, s, node.args[nidx],
+                            node.args[didx] if len(node.args) > didx
+                            else None,
+                            node.lineno, consts, params)
+            elif isinstance(node, ast.Subscript):
+                base = dotted(node.value) or ""
+                if base.split(".")[-1] == "environ":
+                    self._record_read(mod, s, node.slice, None, node.lineno,
+                                      consts, params, presence=True)
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                base = dotted(node.comparators[0]) or ""
+                if base.split(".")[-1] == "environ":
+                    self._record_read(mod, s, node.left, None, node.lineno,
+                                      consts, params, presence=True)
+
+    def _record_read(self, mod, s: _Summary, name_node, default_node,
+                     line: int, consts: dict, params: set,
+                     presence: bool = False) -> None:
+        name = self._resolve_name(name_node, consts)
+        if name is None:
+            if isinstance(name_node, ast.Name) and \
+                    name_node.id not in params:
+                self.warnings.append(
+                    f"env-knobs: {mod.relpath}:{line} reads the environment "
+                    f"through unresolvable name `{name_node.id}`")
+            return
+        if not _KNOB_RE.match(name):
+            return
+        if presence or default_node is None:
+            s.add(name, _NO_DEFAULT, line)
+            return
+        value = _const_eval(default_node, consts)
+        s.add(name, _NO_DEFAULT if value is _NO_DEFAULT else _canon(value),
+              line)
+
+    def _collect_paired_tables(self, tree: ast.Module, s: _Summary,
+                               consts: dict) -> None:
+        """rpc.py's `_ENV_FIELDS = (("field", "IGLOO_..."), ...)` pattern:
+        each env name pairs with a dataclass field whose default is the
+        knob's default."""
+        class_defaults: dict = {}
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                for st in node.body:
+                    if isinstance(st, ast.AnnAssign) and \
+                            isinstance(st.target, ast.Name) and \
+                            st.value is not None:
+                        v = _const_eval(st.value, consts)
+                        if v is not _NO_DEFAULT:
+                            class_defaults[st.target.id] = v
+        for node in tree.body:
+            if not (isinstance(node, ast.Assign) and
+                    isinstance(node.value, (ast.Tuple, ast.List))):
+                continue
+            for elt in node.value.elts:
+                if not (isinstance(elt, (ast.Tuple, ast.List)) and
+                        len(elt.elts) == 2):
+                    continue
+                field = const_str(elt.elts[0])
+                env = const_str(elt.elts[1])
+                if field is None or env is None or not _KNOB_RE.match(env):
+                    continue
+                default = class_defaults.get(field, _NO_DEFAULT)
+                s.add(env, _canon(default)
+                      if default is not _NO_DEFAULT else _NO_DEFAULT,
+                      elt.lineno)
+
+    # --- pass 2 -----------------------------------------------------------
+
+    def _doc(self) -> Path:
+        return self.doc_path if self.doc_path is not None else DEFAULT_DOC
+
+    def _doc_rows(self) -> Optional[dict]:
+        """knob -> {"twin": (section, key) | None, "default": str | None,
+        "line": int} from the catalog's table rows."""
+        doc = self._doc()
+        if not doc.exists():
+            return None
+        rows: dict = {}
+        for i, line in enumerate(doc.read_text().splitlines(), start=1):
+            if not line.lstrip().startswith("|"):
+                continue
+            cells = [c.strip() for c in line.split("|")]
+            m = _DOC_KNOB_RE.search(cells[1] if len(cells) > 1 else "")
+            if not m:
+                continue
+            twin = None
+            if len(cells) > 2:
+                tm = _TWIN_RE.search(cells[2])
+                if tm:
+                    twin = (tm.group(1), tm.group(2))
+            default = None
+            if len(cells) > 3:
+                default = cells[3].strip("`").strip()
+                if default.startswith('"') and default.endswith('"'):
+                    default = default[1:-1]
+            rows[m.group(1)] = {"twin": twin, "default": default, "line": i}
+        return rows
+
+    def _config_fields(self) -> Optional[dict]:
+        """config.py dataclass name -> {field: line}."""
+        path = self.config_path if self.config_path is not None \
+            else DEFAULT_CONFIG
+        if path is None or not Path(path).exists():
+            return None
+        try:
+            tree = ast.parse(Path(path).read_text())
+        except SyntaxError:
+            return None
+        out: dict = {}
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                fields = {}
+                for st in node.body:
+                    if isinstance(st, ast.AnnAssign) and \
+                            isinstance(st.target, ast.Name):
+                        fields[st.target.id] = st.lineno
+                out[node.name] = fields
+        return out
+
+    def judge(self, summaries: dict) -> Iterable[Finding]:
+        rows = self._doc_rows()
+        doc_rel = self._doc()
+        try:
+            doc_rel = Path(doc_rel).resolve().relative_to(
+                REPO_ROOT.resolve()).as_posix()
+        except ValueError:
+            doc_rel = str(doc_rel)
+        if rows is None:
+            return [Finding(RULE, doc_rel, 1,
+                            "knob catalog docs/knobs.md is missing")]
+        out: list = []
+        # fold with module attribution for findings
+        sited: dict = {}   # knob -> [(default, relpath, line)]
+        for rel, s in summaries.items():
+            if s is None:
+                continue
+            for knob, sites in s.reads.items():
+                for default, line in sites:
+                    sited.setdefault(knob, []).append((default, rel, line))
+        full = self.full
+        if full is None:
+            pkg = {p.resolve().relative_to(REPO_ROOT.resolve()).as_posix()
+                   for p in iter_package_files()}
+            full = bool(pkg) and pkg <= set(summaries)
+        # code -> doc
+        for knob, sites in sorted(sited.items()):
+            row = rows.get(knob)
+            if row is None:
+                default, rel, line = sites[0]
+                out.append(Finding(
+                    RULE, rel, line,
+                    f"env knob {knob} is read here but has no row in "
+                    "docs/knobs.md"))
+                continue
+            inline = [(d, rel, line) for d, rel, line in sites
+                      if d is not _NO_DEFAULT]
+            firsts = {d for d, _rel, _line in inline}
+            if len(firsts) > 1:
+                # cite the first site that actually DIFFERS from site 0
+                d, rel, line = next(s for s in inline
+                                    if s[0] != inline[0][0])
+                out.append(Finding(
+                    RULE, rel, line,
+                    f"{knob} default {d!r} here disagrees with "
+                    f"{inline[0][0]!r} at {inline[0][1]}:{inline[0][2]}"))
+            if inline:
+                d, rel, line = inline[0]
+                doc_default = row["default"]
+                if doc_default is None or \
+                        not _same_default(d, doc_default):
+                    out.append(Finding(
+                        RULE, rel, line,
+                        f"{knob} code default {d!r} does not match the "
+                        f"docs/knobs.md default "
+                        f"{doc_default!r} (row at line {row['line']})"))
+        # doc -> code + twins
+        config = self._config_fields()
+        for knob, row in sorted(rows.items()):
+            if full and knob not in sited:
+                out.append(Finding(
+                    RULE, doc_rel, row["line"],
+                    f"docs/knobs.md row for {knob} matches no env read in "
+                    "the package — stale knob"))
+            twin = row["twin"]
+            if twin is not None and config is not None:
+                section, key = twin
+                cls = _SECTION_CLASSES.get(section)
+                fields = config.get(cls or "", {})
+                if cls is None or key not in fields:
+                    out.append(Finding(
+                        RULE, doc_rel, row["line"],
+                        f"{knob} names config twin [{section}] {key}, but "
+                        f"config.py has no such key"))
+        # reverse twin check: every twinned-section config field needs a row
+        if full and config is not None:
+            cfg_path = self.config_path if self.config_path is not None \
+                else DEFAULT_CONFIG
+            try:
+                cfg_rel = Path(cfg_path).resolve().relative_to(
+                    REPO_ROOT.resolve()).as_posix()
+            except ValueError:
+                cfg_rel = str(cfg_path)
+            documented = {row["twin"] for row in rows.values()
+                          if row["twin"] is not None}
+            for section in _TWINNED_SECTIONS:
+                cls = _SECTION_CLASSES[section]
+                for fld, line in sorted(config.get(cls, {}).items()):
+                    if (section, fld) not in documented:
+                        out.append(Finding(
+                            RULE, cfg_rel, line,
+                            f"[{section}] {fld} has no docs/knobs.md row "
+                            "naming its env twin"))
+        return out

@@ -1,0 +1,194 @@
+"""flight-actions: action names match the registry, both directions.
+
+Both Flight servers (coordinator + worker) dispatch control actions by
+string; every client-side helper (``rpc.flight_action`` /
+``flight_action_raw`` / the batched ``flight_actions_raw`` tuples /
+``DistributedClient._action`` / ``Worker._coordinator_action``) names its
+action by string too. A typo on either side is a runtime "unknown action"
+on a live cluster — or worse, a dead server branch nothing ever calls. The
+registry in ``cluster/protocol.py`` (COORDINATOR_ACTIONS / WORKER_ACTIONS +
+ACTION_SERVERS) is the single declaration; this checker holds the code to
+it:
+
+- in each registered server module, the ``action.type == "..."`` literals
+  dispatched inside ``do_action`` must match the registry table EXACTLY —
+  an undeclared dispatch and a declared-but-unserved action are both
+  findings (both directions);
+- ANY module defining a ``do_action`` method may only dispatch names from
+  the registry union (fixture servers and future endpoints included);
+- ``list_actions`` literal entries must name registry actions;
+- every action-name literal at a call helper must be in the registry union;
+- a registry action with no in-package caller is a warning only — several
+  actions exist for external/stock clients (trace, serving_status,
+  poll_flight_info) and for tests/scripts.
+
+Whole-program by nature: subclass of the two-pass checker API.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Optional
+
+from igloo_tpu.lint import (
+    REPO_ROOT, Finding, LintModule, TwoPassChecker, const_str,
+    iter_package_files,
+)
+from igloo_tpu.lint.protocol_registry import Registry, load_registry
+
+RULE = "flight-actions"
+
+DEFAULT_REGISTRY = REPO_ROOT / "igloo_tpu" / "cluster" / "protocol.py"
+
+#: helper name -> positional index of the action-name argument
+_CALL_HELPERS = {"flight_action": 1, "flight_action_raw": 1,
+                 "_action": 0, "_coordinator_action": 0}
+
+
+def _dotted_last(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _Summary:
+    def __init__(self):
+        self.dispatched: list = []   # (name, line) from do_action compares
+        self.listed: list = []       # (name, line) from list_actions tuples
+        self.called: list = []       # (name, line) from call helpers
+        self.tuple_called: set = set()  # names seen as ("name", payload)
+
+
+class FlightActionsChecker(TwoPassChecker):
+    name = RULE
+
+    #: overridable for fixture tests (None -> the real registry)
+    registry_path: Optional[Path] = None
+
+    def __init__(self, registry_path: Optional[Path] = None):
+        super().__init__()
+        if registry_path is not None:
+            self.registry_path = Path(registry_path)
+        self._registry: Optional[Registry] = None
+        self._loaded = False
+        self.warnings: list = []
+
+    def _reg(self) -> Optional[Registry]:
+        if not self._loaded:
+            self._loaded = True
+            self._registry = load_registry(
+                self.registry_path or DEFAULT_REGISTRY, REPO_ROOT)
+        return self._registry
+
+    # --- pass 1 -----------------------------------------------------------
+
+    def collect(self, mod: LintModule):
+        reg = self._reg()
+        if reg is None or mod.path == reg.path:
+            return None, ()
+        s = _Summary()
+        union = set()
+        for table in reg.actions.values():
+            union.update(table)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name == "do_action":
+                    self._collect_dispatch(node, s)
+                elif node.name == "list_actions":
+                    self._collect_listed(node, s)
+            elif isinstance(node, ast.Call):
+                helper = _dotted_last(node.func)
+                idx = _CALL_HELPERS.get(helper or "")
+                if idx is not None and len(node.args) > idx:
+                    name = const_str(node.args[idx])
+                    if name is not None:
+                        s.called.append((name, node.lineno))
+            elif isinstance(node, ast.Tuple) and len(node.elts) == 2:
+                # batched form: yield ("name", payload) into
+                # flight_actions_raw — counts as caller evidence only
+                name = const_str(node.elts[0])
+                if name is not None and name in union:
+                    s.tuple_called.add(name)
+        findings: list = []
+        for name, line in s.dispatched + s.listed + s.called:
+            if name not in union:
+                findings.append(Finding(
+                    RULE, mod.relpath, line,
+                    f"action {name!r} is not declared in the registry "
+                    "(cluster/protocol.py COORDINATOR_ACTIONS / "
+                    "WORKER_ACTIONS)"))
+        return s, findings
+
+    def _collect_dispatch(self, fn, s: _Summary) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare) or \
+                    len(node.comparators) != 1 or \
+                    not isinstance(node.ops[0], ast.Eq):
+                continue
+            left = node.left
+            if isinstance(left, ast.Attribute) and left.attr == "type":
+                name = const_str(node.comparators[0])
+                if name is not None:
+                    s.dispatched.append((name, node.lineno))
+
+    def _collect_listed(self, fn, s: _Summary) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Tuple) and node.elts:
+                name = const_str(node.elts[0])
+                if name is not None:
+                    s.listed.append((name, node.lineno))
+
+    # --- pass 2 -----------------------------------------------------------
+
+    def judge(self, summaries: dict) -> Iterable[Finding]:
+        reg = self._reg()
+        if reg is None:
+            path = self.registry_path or DEFAULT_REGISTRY
+            return [Finding(RULE, str(path), 1,
+                            "flight-actions registry is missing or "
+                            "unparsable")]
+        out: list = []
+        called: set = set()
+        for s in summaries.values():
+            if s is None:
+                continue
+            called.update(n for n, _ in s.called)
+            called.update(s.tuple_called)
+        # exact two-way match per registered server (when linted)
+        for role, relpath in reg.action_servers.items():
+            s = summaries.get(relpath)
+            table = reg.actions.get(role, {})
+            if s is None:
+                continue  # partial run without this server module
+            dispatched = {n for n, _ in s.dispatched}
+            for name, line in sorted(table.items()):
+                if name not in dispatched:
+                    out.append(Finding(
+                        RULE, reg.relpath, line,
+                        f"registry action {name!r} is not dispatched by "
+                        f"{relpath} do_action"))
+            # the other direction, against the server's OWN table: an
+            # action borrowed from the other server's table would dispatch
+            # but never be advertised by this server's generated
+            # list_actions — exactly the drift this rule exists to catch
+            for name, line in s.dispatched:
+                if name not in table:
+                    out.append(Finding(
+                        RULE, relpath, line,
+                        f"{role} do_action dispatches {name!r}, which is "
+                        f"not in the registry's {role} action table"))
+        # stale-registry warnings: actions no package code ever calls (only
+        # meaningful on a whole-package run)
+        pkg = {p.resolve().relative_to(REPO_ROOT.resolve()).as_posix()
+               for p in iter_package_files()}
+        if pkg and pkg <= set(summaries):
+            union = set()
+            for table in reg.actions.values():
+                union.update(table)
+            for name in sorted(union - called):
+                self.warnings.append(
+                    f"flight-actions: registry action `{name}` has no "
+                    "in-package caller (external-client surface?)")
+        return out

@@ -1,0 +1,134 @@
+"""AST extraction of the cluster protocol registry for the lint checkers.
+
+The ``wire-contract`` and ``flight-actions`` rules judge the package against
+the declarative registry in ``igloo_tpu/cluster/protocol.py``. The lint
+framework is pure AST — it never imports checked code — so this module
+re-reads the registry the same way: parse the file, walk the module-level
+``NAME = Message("msg", [Field(...), ...])`` assignments and the literal
+action/name tables. That only works because protocol.py keeps its
+declarations PURE LITERALS (its module docstring states the rule); anything
+non-literal here is skipped and simply invisible to the checkers.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from igloo_tpu.lint import const_str
+
+
+@dataclass
+class FieldSpec:
+    name: str
+    required: bool = False
+    line: int = 1
+
+
+@dataclass
+class MessageSpec:
+    var: str                    # the module-level variable name
+    name: str                   # the wire message name
+    check: str = "flow"         # flow | schema
+    line: int = 1
+    fields: dict = field(default_factory=dict)   # name -> FieldSpec
+
+
+@dataclass
+class Registry:
+    path: Path                  # resolved registry file
+    relpath: str                # as it should appear in findings
+    messages: dict = field(default_factory=dict)      # var -> MessageSpec
+    actions: dict = field(default_factory=dict)       # role -> {name: line}
+    action_servers: dict = field(default_factory=dict)  # role -> relpath
+    wire_modules: list = field(default_factory=list)
+    parse_helpers: dict = field(default_factory=dict)   # helper -> msg name
+
+    def by_message_name(self, name: str) -> Optional[MessageSpec]:
+        for m in self.messages.values():
+            if m.name == name:
+                return m
+        return None
+
+    def flow_fields(self) -> set:
+        """Union of field names of every flow-checked message (the scope of
+        the raw-wire-access rule)."""
+        out: set = set()
+        for m in self.messages.values():
+            if m.check == "flow":
+                out.update(m.fields)
+        return out
+
+
+def _parse_message(var: str, call: ast.Call, line: int
+                   ) -> Optional[MessageSpec]:
+    if not call.args or const_str(call.args[0]) is None:
+        return None
+    spec = MessageSpec(var=var, name=const_str(call.args[0]), line=line)
+    for kw in call.keywords:
+        if kw.arg == "check" and const_str(kw.value):
+            spec.check = const_str(kw.value)
+    if len(call.args) > 1 and isinstance(call.args[1], ast.List):
+        for elt in call.args[1].elts:
+            if not (isinstance(elt, ast.Call)
+                    and isinstance(elt.func, ast.Name)
+                    and elt.func.id == "Field" and elt.args):
+                continue
+            fname = const_str(elt.args[0])
+            if fname is None:
+                continue
+            required = any(
+                kw.arg == "required" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in elt.keywords)
+            spec.fields[fname] = FieldSpec(fname, required=required,
+                                           line=elt.lineno)
+    return spec
+
+
+def load_registry(path: Path, root: Path) -> Optional[Registry]:
+    """Parse the registry file; None when it is missing or unparsable (the
+    checkers turn that into a finding of their own)."""
+    path = Path(path).resolve()
+    if not path.exists():
+        return None
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError:
+        return None
+    try:
+        rel = path.relative_to(Path(root).resolve()).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    reg = Registry(path=path, relpath=rel)
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        var = node.targets[0].id
+        value = node.value
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name) \
+                and value.func.id == "Message":
+            spec = _parse_message(var, value, node.lineno)
+            if spec is not None:
+                reg.messages[var] = spec
+        elif var in ("COORDINATOR_ACTIONS", "WORKER_ACTIONS") and \
+                isinstance(value, ast.Dict):
+            role = "coordinator" if var.startswith("COORD") else "worker"
+            reg.actions[role] = {
+                const_str(k): k.lineno for k in value.keys
+                if const_str(k) is not None}
+        elif var == "ACTION_SERVERS" and isinstance(value, ast.Dict):
+            reg.action_servers = {
+                const_str(k): const_str(v)
+                for k, v in zip(value.keys, value.values)
+                if const_str(k) is not None and const_str(v) is not None}
+        elif var == "WIRE_MODULES" and isinstance(value, ast.List):
+            reg.wire_modules = [const_str(e) for e in value.elts
+                                if const_str(e) is not None]
+        elif var == "PARSE_HELPERS" and isinstance(value, ast.Dict):
+            reg.parse_helpers = {
+                const_str(k): const_str(v)
+                for k, v in zip(value.keys, value.values)
+                if const_str(k) is not None and const_str(v) is not None}
+    return reg

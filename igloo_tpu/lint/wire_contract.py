@@ -1,0 +1,356 @@
+"""wire-contract: whole-program protocol conformance against cluster/protocol.py.
+
+The registry (``igloo_tpu/cluster/protocol.py``) declares every cross-process
+message as typed fields; producers call ``MSG.build(...)`` and consumers call
+``MSG.parse(...)`` (or a registered parse helper). This checker extracts every
+registry-tagged site across ALL package modules — build keyword arguments,
+dict-literal-style writes ``var["f"] = ...`` on tagged variables, and
+``var["f"]`` / ``var.get("f")`` / ``var.pop("f")`` reads on tagged variables
+— and judges the flow globally:
+
+- a field built/written somewhere must be read somewhere
+  (**produced-but-never-consumed** — the dead-wire-field class: PR 11's
+  heartbeat ``ts`` shipped for three PRs with no reader);
+- a field read somewhere must be built somewhere
+  (**consumed-but-never-produced** — deleting a producer, or typo-forking a
+  key the way the PR 10 overflow tags did, fails the lint instead of
+  silently yielding defaults);
+- a registry field with NO tagged site at all is a **dead field**;
+- an undeclared field at any tagged site is flagged immediately; and
+- inside the registry's declared WIRE_MODULES, plucking a flow-message
+  field straight out of a ``json.loads(...)`` result is **raw wire access**
+  — the PR 7 bug class where a mistyped ticket field surfaced as an opaque
+  mid-execute TypeError instead of a boundary error.
+
+Flow analysis applies to messages declared ``check="flow"``; ``"schema"``
+messages (report shapes whose fields fan out into internal bookkeeping
+dicts) get the per-site checks only. The global judgment runs only when
+every WIRE_MODULES file is in the linted set, so partial runs never produce
+spurious missing-producer noise. Findings from the global pass anchor at the
+``Field(...)`` declaration line in the registry file.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Optional
+
+from igloo_tpu.lint import (
+    REPO_ROOT, Finding, LintModule, TwoPassChecker, const_str, dotted,
+)
+from igloo_tpu.lint.protocol_registry import Registry, load_registry
+
+RULE = "wire-contract"
+
+DEFAULT_REGISTRY = REPO_ROOT / "igloo_tpu" / "cluster" / "protocol.py"
+
+_PROTOCOL_MODULE = "igloo_tpu.cluster.protocol"
+
+
+class _Imports:
+    """How this module refers to the protocol registry."""
+
+    def __init__(self, tree: ast.Module):
+        self.module_aliases: set = set()   # names bound to the module
+        self.direct: dict = {}             # local name -> registry var name
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == _PROTOCOL_MODULE:
+                        self.module_aliases.add(
+                            a.asname or a.name.split(".")[-1])
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "igloo_tpu.cluster":
+                    for a in node.names:
+                        if a.name == "protocol":
+                            self.module_aliases.add(a.asname or "protocol")
+                elif node.module == _PROTOCOL_MODULE:
+                    for a in node.names:
+                        self.direct[a.asname or a.name] = a.name
+
+
+class _Summary:
+    def __init__(self):
+        # (message name, field) -> [(relpath, line), ...]
+        self.produced: dict = {}
+        self.consumed: dict = {}
+
+
+class WireContractChecker(TwoPassChecker):
+    name = RULE
+
+    #: overridable for fixture tests (None -> the real registry)
+    registry_path: Optional[Path] = None
+
+    def __init__(self, registry_path: Optional[Path] = None):
+        super().__init__()
+        if registry_path is not None:
+            self.registry_path = Path(registry_path)
+        self._registry: Optional[Registry] = None
+        self._loaded = False
+        self.warnings: list = []
+
+    # --- registry ---------------------------------------------------------
+
+    def _reg(self, root: Path = REPO_ROOT) -> Optional[Registry]:
+        if not self._loaded:
+            self._loaded = True
+            path = self.registry_path or DEFAULT_REGISTRY
+            self._registry = load_registry(path, root)
+        return self._registry
+
+    # --- pass 1 -----------------------------------------------------------
+
+    def collect(self, mod: LintModule):
+        reg = self._reg()
+        if reg is None or mod.path == reg.path:
+            return None, ()
+        imports = _Imports(mod.tree)
+        summary = _Summary()
+        findings: list = []
+        raw_scope = mod.relpath in reg.wire_modules
+        for scope in self._scopes(mod.tree):
+            self._walk_scope(scope, mod, reg, imports, summary, findings,
+                             raw_scope)
+        return summary, findings
+
+    def _scopes(self, tree: ast.Module) -> list:
+        """Every function body as its own scope, plus the module top level
+        (compound statements included, nested defs excluded — they are their
+        own scopes)."""
+        scopes = [n for n in ast.walk(tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        return [tree] + scopes
+
+    def _iter_stmts(self, body: list):
+        """Statements of one scope in source order, descending into compound
+        statements but NOT into nested function/class definitions."""
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            yield st
+            for attr in ("body", "orelse", "finalbody"):
+                yield from self._iter_stmts(getattr(st, attr, []) or [])
+            for h in getattr(st, "handlers", []) or []:
+                yield from self._iter_stmts(h.body)
+
+    def _walk_scope(self, scope, mod, reg, imports, summary, findings,
+                    raw_scope: bool) -> None:
+        body = scope.body if hasattr(scope, "body") else []
+        tags: dict = {}     # var name -> ("parse"|"build", message name)
+        jvars: set = set()  # vars assigned from json.loads(...)
+        for st in self._iter_stmts(body):
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                tagged = self._msg_call(st.value, reg, imports)
+                if tagged is not None:
+                    tags[name] = tagged
+                    jvars.discard(name)
+                elif self._is_json_loads(st.value):
+                    jvars.add(name)
+                    tags.pop(name, None)
+                else:
+                    tags.pop(name, None)
+                    jvars.discard(name)
+            for node in self._walk_stmt(st):
+                self._visit_node(node, mod, reg, imports, summary, findings,
+                                 tags, jvars, raw_scope)
+
+    def _walk_stmt(self, st):
+        """The expression content of ONE statement: walks the subtree but
+        stops at nested STATEMENTS (a compound statement's body is yielded
+        by _iter_stmts as its own statements — descending here too would
+        visit every nested site once per enclosing level, duplicating
+        findings and evaluating reads against pre-statement tag state)."""
+        stack = [st]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    continue
+                stack.append(child)
+
+    # --- site classification ----------------------------------------------
+
+    def _msg_call(self, node, reg: Registry, imports: _Imports
+                  ) -> Optional[tuple]:
+        """('parse'|'build', message name) when `node` is a registry-tagged
+        call, else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        d = dotted(func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if parts[-1] in ("build", "parse") and len(parts) >= 2:
+            var = parts[-2]
+            spec = reg.messages.get(var)
+            if spec is None:
+                return None
+            anchored = (len(parts) == 2 and var in imports.direct) or \
+                (len(parts) >= 3 and parts[-3] in imports.module_aliases)
+            # fixture trees parse without importing, so accept the bare
+            # `<REGISTRY_VAR>.build/parse` form too when unambiguous
+            if anchored or len(parts) == 2:
+                return (parts[-1], spec.name)
+            return None
+        helper = parts[-1]
+        msg_name = reg.parse_helpers.get(helper)
+        if msg_name is None:
+            return None
+        if len(parts) == 1 and imports.direct.get(helper) == helper:
+            return ("parse", msg_name)
+        if len(parts) >= 2 and parts[-2] in imports.module_aliases:
+            return ("parse", msg_name)
+        return None
+
+    def _is_json_loads(self, node) -> bool:
+        return isinstance(node, ast.Call) and \
+            (dotted(node.func) or "").split(".")[-2:] in (
+                ["json", "loads"], ["loads"])
+
+    # --- per-node checks ---------------------------------------------------
+
+    def _record(self, table: dict, msg: str, fld: str, mod, line) -> None:
+        table.setdefault((msg, fld), []).append((mod.relpath, line))
+
+    def _field_check(self, reg, msg_name: str, fld: str, mod, line,
+                     findings: list, what: str) -> bool:
+        spec = reg.by_message_name(msg_name)
+        if spec is not None and fld not in spec.fields:
+            findings.append(Finding(
+                RULE, mod.relpath, line,
+                f"field {fld!r} {what} message {msg_name!r} but is not "
+                "declared in cluster/protocol.py"))
+            return False
+        return True
+
+    def _visit_node(self, node, mod, reg, imports, summary, findings,
+                    tags: dict, jvars: set, raw_scope: bool) -> None:
+        # build kwargs = production
+        tagged = self._msg_call(node, reg, imports)
+        if tagged is not None and tagged[0] == "build":
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue  # **expansion: not statically analyzable
+                if self._field_check(reg, tagged[1], kw.arg, mod,
+                                     node.lineno, findings, "is built for"):
+                    self._record(summary.produced, tagged[1], kw.arg, mod,
+                                 node.lineno)
+            return
+        # var["f"] reads/writes on tagged vars; raw reads on json vars
+        if isinstance(node, ast.Subscript):
+            key = const_str(node.slice)
+            base = node.value
+            if key is None:
+                return
+            if isinstance(base, ast.Name) and base.id in tags:
+                kind, msg_name = tags[base.id]
+                ok = self._field_check(
+                    reg, msg_name, key, mod, node.lineno, findings,
+                    "is written to" if isinstance(node.ctx, ast.Store)
+                    else "is read from")
+                if not ok:
+                    return
+                if isinstance(node.ctx, ast.Store):
+                    self._record(summary.produced, msg_name, key, mod,
+                                 node.lineno)
+                elif kind == "parse":
+                    self._record(summary.consumed, msg_name, key, mod,
+                                 node.lineno)
+            elif isinstance(base, ast.Name) and base.id in jvars and \
+                    raw_scope and key in reg.flow_fields():
+                findings.append(Finding(
+                    RULE, mod.relpath, node.lineno,
+                    f"raw access to wire field {key!r} on a json.loads "
+                    "result — parse through cluster/protocol.py"))
+            else:
+                direct = self._msg_call(base, reg, imports)
+                if direct is not None and direct[0] == "parse":
+                    if self._field_check(reg, direct[1], key, mod,
+                                         node.lineno, findings,
+                                         "is read from"):
+                        self._record(summary.consumed, direct[1], key, mod,
+                                     node.lineno)
+            return
+        # var.get("f") / var.pop("f")
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("get", "pop") and node.args:
+            key = const_str(node.args[0])
+            if key is None:
+                return
+            base = node.func.value
+            if isinstance(base, ast.Name) and base.id in tags:
+                kind, msg_name = tags[base.id]
+                if self._field_check(reg, msg_name, key, mod, node.lineno,
+                                     findings, "is read from") and \
+                        kind == "parse":
+                    self._record(summary.consumed, msg_name, key, mod,
+                                 node.lineno)
+            elif isinstance(base, ast.Name) and base.id in jvars and \
+                    raw_scope and key in reg.flow_fields():
+                findings.append(Finding(
+                    RULE, mod.relpath, node.lineno,
+                    f"raw access to wire field {key!r} on a json.loads "
+                    "result — parse through cluster/protocol.py"))
+            else:
+                direct = self._msg_call(base, reg, imports)
+                if direct is not None and direct[0] == "parse":
+                    if self._field_check(reg, direct[1], key, mod,
+                                         node.lineno, findings,
+                                         "is read from"):
+                        self._record(summary.consumed, direct[1], key, mod,
+                                     node.lineno)
+
+    # --- pass 2 -----------------------------------------------------------
+
+    def judge(self, summaries: dict) -> Iterable[Finding]:
+        reg = self._reg()
+        if reg is None:
+            path = self.registry_path or DEFAULT_REGISTRY
+            return [Finding(RULE, str(path), 1,
+                            "wire-contract registry is missing or "
+                            "unparsable")]
+        linted = set(summaries)
+        if reg.wire_modules and not set(reg.wire_modules) <= linted:
+            return ()  # partial run: the global flow judgment needs them all
+        produced: dict = {}
+        consumed: dict = {}
+        for s in summaries.values():
+            if s is None:
+                continue
+            for k, sites in s.produced.items():
+                produced.setdefault(k, []).extend(sites)
+            for k, sites in s.consumed.items():
+                consumed.setdefault(k, []).extend(sites)
+        out: list = []
+        for spec in reg.messages.values():
+            if spec.check != "flow":
+                continue
+            for fname, f in spec.fields.items():
+                k = (spec.name, fname)
+                has_p, has_c = k in produced, k in consumed
+                if has_p and not has_c:
+                    where = produced[k][0]
+                    out.append(Finding(
+                        RULE, reg.relpath, f.line,
+                        f"{spec.name}.{fname} is produced (e.g. "
+                        f"{where[0]}:{where[1]}) but never consumed — "
+                        "dead wire field"))
+                elif has_c and not has_p:
+                    where = consumed[k][0]
+                    out.append(Finding(
+                        RULE, reg.relpath, f.line,
+                        f"{spec.name}.{fname} is consumed (e.g. "
+                        f"{where[0]}:{where[1]}) but never produced"))
+                elif not has_p and not has_c:
+                    out.append(Finding(
+                        RULE, reg.relpath, f.line,
+                        f"{spec.name}.{fname} is declared but never "
+                        "produced nor consumed — dead field"))
+        return out

@@ -5,8 +5,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== igloo-lint (sync-hazard / cache-key / lock-discipline / metric-names) =="
+echo "== igloo-lint (hazards + wire-contract / flight-actions / env-knobs) =="
 python -m igloo_tpu.lint
+python -m igloo_tpu.lint --stale-allows -q
 
 echo "== ruff (lint) =="
 if python -c "import ruff" 2>/dev/null || command -v ruff >/dev/null; then

@@ -1,0 +1,14 @@
+"""Mini action registry for the flight-actions fixtures (parsed, never
+imported). actions_server_clean.py dispatches exactly this coordinator
+table; actions_server_missing.py drops `do_thing` and must be flagged."""
+
+COORDINATOR_ACTIONS = {
+    "ping": "liveness",
+    "do_thing": "does the thing",
+}
+
+WORKER_ACTIONS = {}
+
+ACTION_SERVERS = {
+    "coordinator": "igloo_tpu/cluster/actions_server_clean.py",
+}

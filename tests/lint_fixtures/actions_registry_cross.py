@@ -1,0 +1,16 @@
+"""Registry whose coordinator server (actions_server_cross.py) dispatches an
+action that only exists in the WORKER table — declared somewhere, so the
+union check passes, but the server's own generated list_actions would never
+advertise it. The flight-actions checker must flag the cross-table drift."""
+
+COORDINATOR_ACTIONS = {
+    "ping": "liveness",
+}
+
+WORKER_ACTIONS = {
+    "w_only": "a worker-side action",
+}
+
+ACTION_SERVERS = {
+    "coordinator": "igloo_tpu/cluster/actions_server_cross.py",
+}

@@ -1,0 +1,14 @@
+"""Registry whose server module (actions_server_missing.py) fails to
+dispatch a declared action — the flight-actions checker must report
+`do_thing` not dispatched, at this file's table line."""
+
+COORDINATOR_ACTIONS = {
+    "ping": "liveness",
+    "do_thing": "does the thing",
+}
+
+WORKER_ACTIONS = {}
+
+ACTION_SERVERS = {
+    "coordinator": "igloo_tpu/cluster/actions_server_missing.py",
+}

@@ -1,0 +1,21 @@
+"""flight-actions MUST-FLAG per-site fixture: an undeclared dispatch, an
+undeclared list_actions entry, and an undeclared caller name. Each
+offending line carries a BAD marker."""
+
+
+def flight_action(addr, name, payload=None):  # stand-in for cluster.rpc
+    return {}
+
+
+class Server:
+    def do_action(self, context, action):
+        if action.type == "pingg":  # BAD typo-forked dispatch
+            return [b"{}"]
+        return []
+
+    def list_actions(self, context):
+        return [("bogus", "not in the registry")]  # BAD stale listing
+
+
+def call(addr):
+    return flight_action(addr, "nope", {})  # BAD undeclared action call

@@ -1,0 +1,22 @@
+"""flight-actions clean server twin: dispatches exactly the registry's
+coordinator table, lists the same names, calls only declared actions."""
+
+
+def flight_action(addr, name, payload=None):  # stand-in for cluster.rpc
+    return {}
+
+
+class Server:
+    def do_action(self, context, action):
+        if action.type == "ping":
+            return [b"{}"]
+        if action.type == "do_thing":
+            return [b"{}"]
+        raise RuntimeError(f"unknown action {action.type}")
+
+    def list_actions(self, context):
+        return [("ping", "liveness"), ("do_thing", "does the thing")]
+
+
+def call(addr):
+    return flight_action(addr, "do_thing", {})

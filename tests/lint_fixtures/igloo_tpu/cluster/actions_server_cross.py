@@ -1,0 +1,12 @@
+"""flight-actions MUST-FLAG server: dispatches `w_only`, which lives in the
+OTHER server's table — passes the union check but its own list_actions
+(generated from the coordinator table) would never advertise it."""
+
+
+class Server:
+    def do_action(self, context, action):
+        if action.type == "ping":
+            return [b"{}"]
+        if action.type == "w_only":
+            return [b"{}"]
+        return []

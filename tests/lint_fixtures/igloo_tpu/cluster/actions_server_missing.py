@@ -1,0 +1,10 @@
+"""flight-actions MUST-FLAG server: the registry declares `do_thing` but
+this do_action never dispatches it (a dead control-plane entry) — flagged
+at the registry table line."""
+
+
+class Server:
+    def do_action(self, context, action):
+        if action.type == "ping":
+            return [b"{}"]
+        raise RuntimeError(f"unknown action {action.type}")

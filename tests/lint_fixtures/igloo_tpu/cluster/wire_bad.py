@@ -1,0 +1,39 @@
+"""wire-contract MUST-FLAG per-site fixture: undeclared fields at tagged
+build/parse sites, and raw json field plucking inside a wire module. Each
+offending line carries a BAD marker (test_lint asserts the exact set)."""
+import json
+
+from igloo_tpu.cluster import protocol
+
+
+def produce(sql):
+    return protocol.TICKET.build(sql=sql, dead_line_s=1.0)  # BAD typo-fork
+
+
+def consume(raw):
+    t = protocol.TICKET.parse(raw)
+    sql = t["sql"]
+    extra = t.get("deadlines")  # BAD undeclared field read
+    return sql, extra
+
+
+def raw_consume(body):
+    req = json.loads(body)
+    sql = req["sql"]  # BAD raw wire access, bypasses the registry
+    dl = req.get("deadline_s")  # BAD raw wire access
+    return sql, dl
+
+
+def suppressed(body):
+    req = json.loads(body)
+    return req.get("deadline_s")  # lint: allow(wire-contract) fixture check
+
+
+def nested_raw(body, flag):
+    # regression: a site nested under compound statements must be reported
+    # exactly ONCE, not once per enclosing level
+    req = json.loads(body)
+    if flag:
+        if flag > 1:
+            return req["sql"]  # BAD raw wire access (nested twice)
+    return None

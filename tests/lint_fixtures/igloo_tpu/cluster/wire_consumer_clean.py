@@ -1,0 +1,8 @@
+"""wire-contract clean consumer twin: parses through the registry and reads
+every TICKET field off the tagged variable."""
+from igloo_tpu.cluster import protocol
+
+
+def receive(raw):
+    t = protocol.TICKET.parse(raw)
+    return t["sql"], t.get("deadline_s")

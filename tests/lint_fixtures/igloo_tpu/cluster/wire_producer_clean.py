@@ -1,0 +1,10 @@
+"""wire-contract clean producer twin: builds every TICKET field through the
+registry. Linted together with wire_consumer_clean.py -> zero findings."""
+import json
+
+from igloo_tpu.cluster import protocol
+
+
+def send(sql, deadline_s):
+    body = protocol.TICKET.build(sql=sql, deadline_s=deadline_s)
+    return json.dumps(body)

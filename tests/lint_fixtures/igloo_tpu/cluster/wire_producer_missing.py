@@ -1,0 +1,12 @@
+"""wire-contract MUST-FLAG producer: the deadline_s producer was deleted,
+while wire_consumer_clean.py still reads it — the global pass must report
+ticket.deadline_s consumed-but-never-produced (at the registry's Field
+line in wire_registry_missing.py)."""
+import json
+
+from igloo_tpu.cluster import protocol
+
+
+def send(sql):
+    body = protocol.TICKET.build(sql=sql)
+    return json.dumps(body)

@@ -1,0 +1,9 @@
+"""env-knobs MUST-FLAG twin: an undocumented knob and a default that
+drifted from the catalog row. Each offending line carries a BAD marker."""
+import os
+
+
+def knobs():
+    undoc = os.environ.get("IGLOO_FIX_UNDOC", "0")  # BAD no catalog row
+    drift = os.environ.get("IGLOO_FIX_A", "2")  # BAD catalog says 1
+    return undoc, drift

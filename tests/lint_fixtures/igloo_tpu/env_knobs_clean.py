@@ -1,0 +1,11 @@
+"""env-knobs clean twin: every IGLOO_* read here has a knobs_catalog.md row
+with a matching default."""
+import os
+
+FIX_A_ENV = "IGLOO_FIX_A"
+
+
+def knobs():
+    a = os.environ.get(FIX_A_ENV, "1")
+    b = os.environ.get("IGLOO_FIX_B")
+    return a, b

@@ -1,0 +1,6 @@
+"""--stale-allows fixture: one allow that suppresses nothing, one naming an
+unknown rule. (An ACTIVE allow lives in the real tree — exec/cache.py — and
+in wire_bad.py; the report must flag only the dead ones here.)"""
+
+X = 1  # lint: allow(cache-key) suppresses nothing: no finding on this line
+Y = 2  # lint: allow(not-a-rule) unknown rule name

@@ -1,0 +1,7 @@
+"""Fixture config for the env-knobs twin checks: RpcConfig carries one
+field with no documented env twin (must be flagged on full runs)."""
+
+
+class RpcConfig:
+    call_timeout_s: float = 120.0
+    orphan_knob_s: float = 1.0

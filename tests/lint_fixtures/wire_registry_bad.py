@@ -1,0 +1,25 @@
+"""Registry for the per-site MUST-FLAG fixture wire_bad.py (undeclared
+build/read fields, raw json.loads field access). wire_bad.py is the only
+wire module so its raw-access rule is in scope when it is linted alone."""
+
+
+class Field:  # pragma: no cover - parsed, never executed
+    def __init__(self, *a, **kw):
+        pass
+
+
+class Message:  # pragma: no cover - parsed, never executed
+    def __init__(self, *a, **kw):
+        pass
+
+
+TICKET = Message("ticket", [
+    Field("sql", str, required=True),
+    Field("deadline_s", float),
+])
+
+WIRE_MODULES = [
+    "igloo_tpu/cluster/wire_bad.py",
+]
+
+PARSE_HELPERS = {}

@@ -1,0 +1,28 @@
+"""Registry for the MUST-FLAG producer/consumer pair: wire_producer_missing
+builds only `sql`, while wire_consumer_clean reads `deadline_s` too — the
+wire-contract global pass must report consumed-but-never-produced at this
+file's Field("deadline_s") line. Proves that deleting one field producer
+fails the lint (ISSUE 14 acceptance)."""
+
+
+class Field:  # pragma: no cover - parsed, never executed
+    def __init__(self, *a, **kw):
+        pass
+
+
+class Message:  # pragma: no cover - parsed, never executed
+    def __init__(self, *a, **kw):
+        pass
+
+
+TICKET = Message("ticket", [
+    Field("sql", str, required=True),
+    Field("deadline_s", float),
+])
+
+WIRE_MODULES = [
+    "igloo_tpu/cluster/wire_producer_missing.py",
+    "igloo_tpu/cluster/wire_consumer_clean.py",
+]
+
+PARSE_HELPERS = {}

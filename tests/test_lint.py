@@ -191,6 +191,194 @@ def test_span_names_real_catalog_covers_the_tree():
     assert ours == [], [f.render() for f in ours]
 
 
+# --- wire-contract ----------------------------------------------------------
+
+def _wire_checker(registry):
+    from igloo_tpu.lint.wire_contract import WireContractChecker
+    return WireContractChecker(registry_path=FIXTURES / registry)
+
+
+def test_wire_contract_flags_bad_fixture():
+    f = _lint([PKG / "cluster" / "wire_bad.py"],
+              [_wire_checker("wire_registry_bad.py")])
+    ours = [x for x in f if x.path == "igloo_tpu/cluster/wire_bad.py"]
+    assert all(x.rule == "wire-contract" for x in ours)
+    src = (PKG / "cluster" / "wire_bad.py").read_text().splitlines()
+    bad_lines = {i for i, ln in enumerate(src, 1) if "# BAD" in ln}
+    assert {x.line for x in ours} == bad_lines, \
+        ([x.render() for x in ours], sorted(bad_lines))
+    # exactly once per site: a violation nested under compound statements
+    # must not be reported once per enclosing level (review fix)
+    assert len(ours) == len(bad_lines), [x.render() for x in ours]
+
+
+def test_wire_contract_clean_producer_consumer_pair():
+    # the mirrored twins cover every TICKET field: zero findings, global
+    # flow judgment included (both wire modules are in the linted set)
+    f = _lint([PKG / "cluster" / "wire_producer_clean.py",
+               PKG / "cluster" / "wire_consumer_clean.py"],
+              [_wire_checker("wire_registry.py")])
+    assert f == [], [x.render() for x in f]
+
+
+def test_wire_contract_flags_deleted_producer():
+    """ISSUE 14 acceptance: deleting one ticket-field producer makes the
+    checker fail — the consumer still reads deadline_s, nothing builds it."""
+    f = _lint([PKG / "cluster" / "wire_producer_missing.py",
+               PKG / "cluster" / "wire_consumer_clean.py"],
+              [_wire_checker("wire_registry_missing.py")])
+    assert len(f) == 1 and f[0].rule == "wire-contract"
+    assert "deadline_s" in f[0].message and "never produced" in f[0].message
+    assert f[0].path.endswith("wire_registry_missing.py")
+
+
+def test_wire_contract_missing_registry_is_a_finding():
+    f = _lint([PKG / "cluster" / "wire_bad.py"],
+              [_wire_checker("no_such_registry.py")])
+    assert len(f) == 1 and "registry is missing" in f[0].message
+
+
+def test_wire_contract_real_tree_flow_is_complete():
+    """Every flow-checked field of the REAL registry is both produced and
+    consumed in the package (the wired-in validate.sh gate)."""
+    from igloo_tpu.lint.wire_contract import WireContractChecker
+    findings, _w = run_lint(paths=list(iter_package_files()),
+                            checkers=[WireContractChecker()])
+    assert findings == [], [f.render() for f in findings]
+
+
+# --- flight-actions ---------------------------------------------------------
+
+def _actions_checker(registry):
+    from igloo_tpu.lint.flight_actions import FlightActionsChecker
+    return FlightActionsChecker(registry_path=FIXTURES / registry)
+
+
+def test_flight_actions_flags_bad_fixture():
+    f = _lint([PKG / "cluster" / "actions_bad.py"],
+              [_actions_checker("actions_registry.py")])
+    assert all(x.rule == "flight-actions" for x in f)
+    src = (PKG / "cluster" / "actions_bad.py").read_text().splitlines()
+    bad_lines = {i for i, ln in enumerate(src, 1) if "# BAD" in ln}
+    assert {x.line for x in f} == bad_lines, \
+        ([x.render() for x in f], sorted(bad_lines))
+
+
+def test_flight_actions_passes_clean_server():
+    f = _lint([PKG / "cluster" / "actions_server_clean.py"],
+              [_actions_checker("actions_registry.py")])
+    assert f == [], [x.render() for x in f]
+
+
+def test_flight_actions_flags_undispatched_registry_action():
+    # the other direction: declared in the registry, served by nothing
+    f = _lint([PKG / "cluster" / "actions_server_missing.py"],
+              [_actions_checker("actions_registry_missing.py")])
+    assert len(f) == 1 and "do_thing" in f[0].message
+    assert "not dispatched" in f[0].message
+
+
+def test_flight_actions_flags_cross_table_dispatch():
+    # an action borrowed from the OTHER server's table passes the union
+    # check but this server's generated list_actions never advertises it
+    f = _lint([PKG / "cluster" / "actions_server_cross.py"],
+              [_actions_checker("actions_registry_cross.py")])
+    assert len(f) == 1 and "w_only" in f[0].message, \
+        [x.render() for x in f]
+    assert "not in the registry's coordinator action table" in f[0].message
+
+
+def test_two_pass_checker_summaries_do_not_leak_across_runs():
+    # a reused checker instance must judge each run on its own modules: the
+    # first (full) run sees the missing producer; the second (partial) run
+    # must gate its global pass off instead of judging stale summaries
+    c = _wire_checker("wire_registry_missing.py")
+    first = _lint([PKG / "cluster" / "wire_producer_missing.py",
+                   PKG / "cluster" / "wire_consumer_clean.py"], [c])
+    assert len(first) == 1
+    second = _lint([PKG / "cluster" / "wire_producer_missing.py"], [c])
+    assert second == [], [x.render() for x in second]
+
+
+# --- env-knobs --------------------------------------------------------------
+
+def _knobs_checker(**kw):
+    from igloo_tpu.lint.env_knobs import EnvKnobsChecker
+    kw.setdefault("doc_path", FIXTURES / "knobs_catalog.md")
+    kw.setdefault("config_path", FIXTURES / "no_such_config.py")
+    return EnvKnobsChecker(**kw)
+
+
+def test_env_knobs_flags_bad_fixture():
+    f = _lint([PKG / "env_knobs_bad.py"], [_knobs_checker()])
+    assert all(x.rule == "env-knobs" for x in f)
+    src = (PKG / "env_knobs_bad.py").read_text().splitlines()
+    bad_lines = {i for i, ln in enumerate(src, 1) if "# BAD" in ln}
+    assert {x.line for x in f} == bad_lines, \
+        ([x.render() for x in f], sorted(bad_lines))
+
+
+def test_env_knobs_passes_clean_fixture():
+    assert _lint([PKG / "env_knobs_clean.py"], [_knobs_checker()]) == []
+
+
+def test_env_knobs_flags_stale_catalog_row():
+    # deleting a knob's reader (or documenting a knob that never existed)
+    # fails the checker on a full run: ISSUE 14 acceptance, doc side
+    f = _lint([PKG / "env_knobs_clean.py"], [_knobs_checker(full=True)])
+    assert len(f) == 1 and "IGLOO_FIX_STALE" in f[0].message
+    assert "stale knob" in f[0].message
+
+
+def test_env_knobs_config_twin_checks():
+    f = _lint([PKG / "env_knobs_clean.py"],
+              [_knobs_checker(config_path=FIXTURES / "mini_config.py",
+                              full=True)])
+    msgs = [x.message for x in f]
+    assert any("[rpc] call_timeout_s has no docs/knobs.md row" in m
+               for m in msgs), msgs
+    assert any("orphan_knob_s" in m for m in msgs), msgs
+
+
+def test_env_knobs_real_tree_catalog_is_complete():
+    """Every IGLOO_* read in the package has a docs/knobs.md row with a
+    matching default, and every row a live reader."""
+    from igloo_tpu.lint.env_knobs import EnvKnobsChecker
+    findings, warnings = run_lint(paths=list(iter_package_files()),
+                                  checkers=[EnvKnobsChecker()])
+    assert findings == [], [f.render() for f in findings]
+    assert not warnings, warnings
+
+
+# --- stale-allows report mode -----------------------------------------------
+
+def test_stale_allows_flags_only_dead_suppressions():
+    from igloo_tpu.lint import stale_allows
+    out = stale_allows(paths=[PKG / "stale_allow.py",
+                              PKG / "exec" / "sync_bad.py"],
+                       root=FIXTURES)
+    by_line = {(f.path, f.line): f.message for f in out}
+    # the dead allow and the unknown-rule allow are flagged...
+    assert any("suppresses nothing" in m for m in by_line.values())
+    assert any("no known rule" in m for m in by_line.values())
+    assert all(p == "igloo_tpu/stale_allow.py" for p, _ in by_line)
+    # ...while sync_bad.py's allow still suppresses a real finding
+    # (root=FIXTURES keeps it inside the sync-hazard hot-module scope)
+
+
+def test_stale_allows_cli_exit_codes(capsys, monkeypatch):
+    from igloo_tpu.lint.__main__ import main
+    repo = Path(__file__).resolve().parent.parent
+    monkeypatch.chdir(repo)
+    # the real tree's allows are all live (the in-tree cleanup this report
+    # mode exists to keep true)
+    assert main(["--stale-allows", "-q", "igloo_tpu/exec/cache.py"]) == 0
+    assert main(["--stale-allows",
+                 "tests/lint_fixtures/igloo_tpu/stale_allow.py"]) == 1
+    capsys.readouterr()
+    assert main(["--stale-allows", "--select", "cache-key"]) == 2
+
+
 # --- framework --------------------------------------------------------------
 
 def test_suppression_comment_silences_one_line():

@@ -333,14 +333,14 @@ def _slot_worker(slots: int):
     state = {"cur": 0, "peak": 0}
     lock = threading.Lock()
 
-    def fake_fragment(req):
+    def fake_fragment(frag_id, plan_json, addr_of, deadline):
         with lock:
             state["cur"] += 1
             state["peak"] = max(state["peak"], state["cur"])
         time.sleep(0.15)
         with lock:
             state["cur"] -= 1
-        return {"id": req.get("id", "?"), "rows": 0, "elapsed_s": 0.0,
+        return {"id": frag_id, "rows": 0, "elapsed_s": 0.0,
                 "worker": server.worker_id}
 
     server._execute_fragment = fake_fragment
@@ -355,7 +355,8 @@ def test_worker_slot_bound_serializes_fragments():
 
         def call(i):
             try:
-                rpc.flight_action(addr, "execute_fragment", {"id": f"f{i}"})
+                rpc.flight_action(addr, "execute_fragment",
+                                  {"id": f"f{i}", "plan": {}})
             except Exception as ex:  # pragma: no cover - fails the assert
                 errs.append(ex)
 
@@ -378,13 +379,15 @@ def test_worker_slot_timeout_answers_retryably():
     addr = f"127.0.0.1:{server.port}"
     try:
         t = threading.Thread(target=lambda: rpc.flight_action(
-            addr, "execute_fragment", {"id": "long"}), daemon=True)
+            addr, "execute_fragment", {"id": "long", "plan": {}}),
+            daemon=True)
         t.start()
         _wait_until(lambda: state["cur"] == 1, msg="slot occupied")
         before = _counter("worker.slot_timeouts")
         with pytest.raises(flight.FlightUnavailableError, match="slots"):
             rpc.flight_action(addr, "execute_fragment",
-                              {"id": "starved", "timeout_s": 0.02},
+                              {"id": "starved", "plan": {},
+                               "timeout_s": 0.02},
                               policy=rpc.default_policy().with_(retries=0))
         assert _counter("worker.slot_timeouts") == before + 1
         t.join(timeout=10)

@@ -170,11 +170,16 @@ def test_salted_extras_avoid_hot_buckets_placed_worker():
 
 def test_worker_info_serde_roundtrip_and_legacy():
     from igloo_tpu.cluster import serde
-    d = serde.worker_info_to_json("w1", "grpc+tcp://h:1", devices=4, slots=2,
-                                  ts=123.0)
+    d = serde.worker_info_to_json("w1", "grpc+tcp://h:1", devices=4, slots=2)
     info = serde.worker_info_from_json(d)
     assert info == {"id": "w1", "addr": "grpc+tcp://h:1", "devices": 4,
                     "slots": 2}
+    # the retired wall-clock `ts` field must be GONE from the payload (no
+    # consumer ever read it — wire-contract true positive, PR 14) but a
+    # legacy payload still carrying it must parse untouched
+    assert "ts" not in d
+    old = serde.worker_info_from_json({"id": "w1", "addr": "a", "ts": 1.0})
+    assert old["id"] == "w1" and old["devices"] == 1
     # a pre-topology worker's payload registers as single-device
     legacy = serde.worker_info_from_json({"id": "w0", "addr": "x"})
     assert legacy["devices"] == 1 and legacy["slots"] == 0
