@@ -16,10 +16,19 @@ is invalidating the cache when a source changes. This is that capability:
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Optional
 
 from igloo_tpu.exec.cache import provider_snapshot
+from igloo_tpu.utils import tracing
+
+log = logging.getLogger("igloo_tpu")
+
+# lock discipline (igloo-lint lock-discipline): the registration path
+# (on_change, any thread) and the poll sweep (watch thread) share both the
+# seen-token map and the callback list
+_GUARDED_BY = {"_lock": ("_seen", "_callbacks")}
 
 
 class SourceWatcher:
@@ -33,12 +42,19 @@ class SourceWatcher:
         self._lock = threading.Lock()
 
     def on_change(self, fn: Callable[[str], None]) -> None:
-        """Register a callback fired with the table name on each change."""
-        self._callbacks.append(fn)
+        """Register a callback fired with the table name on each change.
+        Lock-guarded: registration may race the watch thread's poll()
+        (list.append alone would also race a concurrent snapshot read)."""
+        with self._lock:
+            self._callbacks.append(fn)
 
     def poll(self) -> list[str]:
         """One sweep: returns the list of tables whose source changed, after
-        evicting them from the engine's batch cache."""
+        evicting them from the engine's batch cache. Callbacks run OUTSIDE
+        the lock (a slow subscriber must not stall registration) and a
+        raising callback is counted (`cdc.callback_errors`) and logged
+        instead of killing the watch thread — one bad subscriber cannot
+        turn eager invalidation off for everyone else."""
         changed = []
         with self._lock:
             for name in self.engine.catalog.names():
@@ -54,9 +70,15 @@ class SourceWatcher:
                         host.invalidate_table(name)
                     changed.append(name)
                 self._seen[name] = tok
+            callbacks = list(self._callbacks)
         for name in changed:
-            for fn in self._callbacks:
-                fn(name)
+            for fn in callbacks:
+                try:
+                    fn(name)
+                except Exception:
+                    tracing.counter("cdc.callback_errors")
+                    log.exception("cdc: on_change callback failed for "
+                                  "table %r", name)
         return changed
 
     def watch(self) -> "SourceWatcher":

@@ -26,13 +26,16 @@ def sample_users_table() -> pa.Table:
 
 
 def build_engine(cfg, use_jit: bool = True):
-    from igloo_tpu.config import init_distributed, make_provider
+    from igloo_tpu.config import apply_storage, init_distributed, \
+        make_provider
     from igloo_tpu.engine import QueryEngine
     kw = {}
     if cfg is not None:
         # multi-host runtime first: jax.distributed.initialize must run
         # before the first device query or the process stays single-host
         init_distributed(cfg)
+        # [storage] policy + prefetch twins (env wins per-field)
+        apply_storage(cfg)
         kw["cache_budget_bytes"] = cfg.cache_budget_bytes
         if cfg.mesh_shape:
             import math

@@ -1493,11 +1493,15 @@ def main(argv=None) -> int:
     server = CoordinatorServer(f"grpc+tcp://{args.host}:{args.port}",
                                worker_timeout_s=timeout)
     if args.config:
-        from igloo_tpu.config import Config, make_provider, rpc_policy
+        from igloo_tpu.config import (
+            Config, apply_storage, make_provider, rpc_policy,
+        )
         cfg = Config.load(args.config)
         server.membership.timeout_s = cfg.cluster.worker_timeout_s
         # [rpc] config is the base; IGLOO_RPC_* env still wins per-field
         rpc.set_default_policy(rpc.policy_from_env(rpc_policy(cfg)))
+        # [storage] likewise (policy + prefetch twins; env wins per-field)
+        apply_storage(cfg)
         if cfg.rpc.query_deadline_s is not None and \
                 not os.environ.get(QUERY_DEADLINE_ENV):
             # same precedence as every other [rpc] knob: env beats config;

@@ -21,6 +21,10 @@ shed — and ``serving.dequeue`` on every admission grant), driven by a spec:
                   ``drop-mid-stream``  for the streaming points
                              (``worker.do_get``, ``coordinator.do_get``):
                              serve one batch, then fail the stream
+                  ``corrupt``  for the data points that pass payload bytes
+                             through ``corrupt_data()`` (``storage.
+                             get_range``): flip bytes in the returned
+                             buffer — silent bitrot, same etag
 - ``prob``        per-call injection probability in [0, 1]
 - ``count``       optional cap on total injections for the rule
 
@@ -50,7 +54,7 @@ SEED_ENV = "IGLOO_FAULTS_SEED"
 DELAY_ENV = "IGLOO_FAULTS_DELAY_S"
 HANG_ENV = "IGLOO_FAULTS_HANG_S"
 
-MODES = ("error", "delay", "hang", "drop-mid-stream")
+MODES = ("error", "delay", "hang", "drop-mid-stream", "corrupt")
 
 
 class FaultSpecError(ValueError):
@@ -126,11 +130,15 @@ class FaultInjector:
                                    count=count, rng=rng))
         return rules
 
-    def match(self, point: str, stream: bool = False) -> Optional[FaultRule]:
+    def match(self, point: str, stream: bool = False,
+              corrupt: bool = False) -> Optional[FaultRule]:
         """First firing rule for `point`. Stream points only take
-        drop-mid-stream rules; call points take everything else."""
+        drop-mid-stream rules, data points only corrupt rules; call points
+        take everything else."""
         for r in self.rules:
             if (r.mode == "drop-mid-stream") is not stream:
+                continue
+            if (r.mode == "corrupt") is not corrupt:
                 continue
             if fnmatch.fnmatchcase(point, r.pattern) and r.decide():
                 return r
@@ -205,6 +213,25 @@ def inject(point: str) -> None:
     import pyarrow.flight as flight
     raise flight.FlightUnavailableError(
         f"igloo fault injection: {rule.pattern}:{rule.mode} at {point}")
+
+
+def corrupt_data(point: str, data: bytes) -> bytes:
+    """Apply a matching ``corrupt`` rule to a payload: flips a byte run in
+    the middle of the buffer (silent bitrot — the object's etag is
+    untouched, so only checksum/parse validation can catch it). No rule, no
+    copy; empty payloads pass through untouched."""
+    inj = _INJECTOR
+    if inj is None or not data:
+        return data
+    rule = inj.match(point, corrupt=True)
+    if rule is None:
+        return data
+    tracing.counter("faults.injected")
+    buf = bytearray(data)
+    start = len(buf) // 2
+    for i in range(start, min(start + 64, len(buf))):
+        buf[i] ^= 0xFF
+    return bytes(buf)
 
 
 def wrap_stream(point: str, batches: Iterator) -> Iterator:

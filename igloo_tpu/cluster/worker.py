@@ -722,13 +722,16 @@ def main(argv=None) -> int:
 
     hb = 5.0
     if args.config:
-        from igloo_tpu.config import Config, rpc_policy
+        from igloo_tpu.config import Config, apply_storage, rpc_policy
         cfg = Config.load(args.config)
         hb = cfg.cluster.heartbeat_interval_s
         # [rpc] config is the base; IGLOO_RPC_* env still wins per-field
         # (the worker's registration, heartbeats, and peer dep-fetches all
         # run under this policy)
         rpc.set_default_policy(rpc.policy_from_env(rpc_policy(cfg)))
+        # [storage] likewise: the worker's fragment scans read through the
+        # same policy-governed object-store layer the engine uses
+        apply_storage(cfg)
     w = Worker(args.coordinator, host=args.host, port=args.port,
                heartbeat_interval_s=hb)
     w.start()

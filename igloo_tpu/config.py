@@ -82,6 +82,25 @@ class ServingConfig:
 
 
 @dataclass
+class StorageConfig:
+    """Object-store failure budget + prefetch ([storage] TOML section; every
+    field is also overridable per-process via the matching IGLOO_STORAGE_*
+    env var — env wins, like [rpc]). See docs/storage.md for semantics.
+
+    None = "not set in the TOML": the numeric defaults live in ONE place —
+    storage/policy.py's StoragePolicy and storage/prefetch.py — so a tuned
+    default is never silently shadowed by a stale copy here."""
+    connect_timeout_s: Optional[float] = None
+    read_timeout_s: Optional[float] = None
+    retries: Optional[int] = None
+    backoff_base_s: Optional[float] = None
+    backoff_max_s: Optional[float] = None
+    backoff_jitter: Optional[float] = None
+    prefetch: Optional[bool] = None          # False = kill switch
+    prefetch_bytes: Optional[int] = None
+
+
+@dataclass
 class DistributedConfig:
     """Multi-host JAX runtime (SURVEY #20 "jax distributed init").
 
@@ -110,6 +129,7 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     rpc: RpcConfig = field(default_factory=RpcConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
     distributed: DistributedConfig = field(default_factory=DistributedConfig)
     use_jit: bool = True
 
@@ -157,6 +177,12 @@ class Config:
                   "hbm_budget_bytes", "weights"):
             if k in sv:
                 setattr(cfg.serving, k, sv[k])
+        st = raw.get("storage", {})
+        for k in ("connect_timeout_s", "read_timeout_s", "retries",
+                  "backoff_base_s", "backoff_max_s", "backoff_jitter",
+                  "prefetch", "prefetch_bytes"):
+            if k in st:
+                setattr(cfg.storage, k, st[k])
         ds = raw.get("distributed", {})
         for k in ("enabled", "coordinator_address", "num_processes",
                   "process_id", "local_device_ids"):
@@ -201,6 +227,27 @@ def rpc_policy(cfg: "Config"):
                     "backoff_jitter")
           if getattr(cfg.rpc, f) is not None}
     return RpcPolicy(**kw)
+
+
+def storage_policy(cfg: "Config"):
+    """[storage] section -> storage StoragePolicy (only fields actually set
+    in the TOML are passed — unset ones keep the StoragePolicy defaults)."""
+    from igloo_tpu.storage.policy import StoragePolicy
+    kw = {f: getattr(cfg.storage, f)
+          for f in ("connect_timeout_s", "read_timeout_s", "retries",
+                    "backoff_base_s", "backoff_max_s", "backoff_jitter")
+          if getattr(cfg.storage, f) is not None}
+    return StoragePolicy(**kw)
+
+
+def apply_storage(cfg: "Config") -> None:
+    """Install the [storage] section process-wide: the policy as the
+    default every ObjectStore uses (env still wins per field —
+    policy_from_env layers on top) and the prefetch twins."""
+    from igloo_tpu.storage import policy as sp
+    from igloo_tpu.storage import prefetch as spf
+    sp.set_default_policy(sp.policy_from_env(storage_policy(cfg)))
+    spf.configure(cfg.storage.prefetch, cfg.storage.prefetch_bytes)
 
 
 def make_provider(t: TableConfig):

@@ -5,18 +5,24 @@ src/lib.rs:12-46), which reads a whole CSV into Vec<Vec<String>> under its own
 private TableProvider trait, disconnected from the engine. Ours implements the
 ENGINE's provider protocol (typed arrow decode via pyarrow's C++ CSV reader, and
 the coordinator's ListingTable fixture use-case, coordinator/src/main.rs:26-45).
+
+Reads route through the object-store layer (igloo_tpu/storage): policy-
+retried verified reads, pinned snapshot etags (mid-query mutation raises a
+typed `SnapshotChanged`), and a vanished file mapped to a snapshot change
+instead of a raw FileNotFoundError — same contract as the parquet
+connector (docs/storage.md).
 """
 from __future__ import annotations
 
-import glob as _glob
-import os
 from typing import Optional
 
 import pyarrow as pa
 import pyarrow.csv as pacsv
 
-from igloo_tpu.errors import ConnectorError
+from igloo_tpu.errors import ConnectorError, SnapshotChanged, StorageError
 from igloo_tpu.exec.batch import schema_from_arrow
+from igloo_tpu.storage import local_store
+from igloo_tpu.storage import snapshot as _snapshot
 from igloo_tpu.types import Schema
 
 
@@ -29,11 +35,13 @@ class CsvTable:
         return self
 
     def __init__(self, path: str, has_header: bool = True,
-                 delimiter: str = ","):
+                 delimiter: str = ",", store=None):
         self.path = path
         self.has_header = has_header
         self.delimiter = delimiter
-        self._files = _expand(path)
+        self._store = store if store is not None else local_store()
+        from igloo_tpu.connectors.parquet import _expand_store
+        self._files = _expand_store(self._store, path, suffix=".csv")
         if not self._files:
             raise ConnectorError(f"no csv files at {path}")
         self._schema_arrow = self._read_file(self._files[0]).schema
@@ -43,34 +51,47 @@ class CsvTable:
         if self.has_header:
             ropts = pacsv.ReadOptions()
         else:
-            # peek at first line for column count
-            with open(self._files[0], "r", encoding="utf-8") as fh:
-                first = fh.readline()
-            n = len(first.rstrip("\n").split(self.delimiter))
+            # peek at the head for the column count (one small ranged read)
+            head = self._store.get_range(self._files[0], 0, 65536)
+            first = head.decode("utf-8", "replace").split("\n", 1)[0]
+            n = len(first.rstrip("\r\n").split(self.delimiter))
             ropts = pacsv.ReadOptions(
                 column_names=[f"column_{i + 1}" for i in range(n)])
         return ropts
 
+    def _open(self, path: str):
+        pins = _snapshot.pinned_etags(self)
+        want = pins.get(path) if pins is not None else None
+        try:
+            return self._store.open_input(path, want_etag=want,
+                                          table=self.path)
+        except FileNotFoundError:
+            raise SnapshotChanged(
+                f"csv file vanished: {path} (table {self.path})",
+                table=self.path, key=path) from None
+
     def _read_file(self, path: str) -> pa.Table:
         try:
             return pacsv.read_csv(
-                path, read_options=self._read_opts(),
+                self._open(path), read_options=self._read_opts(),
                 parse_options=pacsv.ParseOptions(delimiter=self.delimiter))
-        except FileNotFoundError:
-            raise ConnectorError(f"csv file not found: {path}") from None
+        except (SnapshotChanged, StorageError):
+            raise
         except pa.ArrowInvalid as ex:
             raise ConnectorError(f"csv parse failed for {path}: {ex}") from None
 
     def snapshot(self):
-        from igloo_tpu.connectors.parquet import file_snapshot
-        return file_snapshot(self._files)
+        tok, _etags = _snapshot.pin(self, self._snapshot_now)
+        return tok
+
+    def _snapshot_now(self) -> tuple:
+        return self._store.snapshot_token(self._files)
 
     def schema(self) -> Schema:
         return self._schema
 
     def estimated_bytes(self):
-        from igloo_tpu.connectors.parquet import files_bytes
-        return files_bytes(self._files)
+        return self._store.files_bytes(self._files)
 
     def num_partitions(self) -> int:
         return len(self._files)
@@ -88,12 +109,3 @@ class CsvTable:
         if projection is not None:
             t = t.select(projection)
         return t
-
-
-def _expand(path: str) -> list[str]:
-    if os.path.isdir(path):
-        return sorted(_glob.glob(os.path.join(path, "**", "*.csv"),
-                                 recursive=True))
-    if any(ch in path for ch in "*?["):
-        return sorted(_glob.glob(path))
-    return [path] if os.path.exists(path) else []

@@ -23,8 +23,10 @@ import pyarrow.parquet as pq
 
 from igloo_tpu.connectors.avro import read_avro_file
 from igloo_tpu.connectors.parquet import _prune_row_groups
-from igloo_tpu.errors import ConnectorError
+from igloo_tpu.errors import ConnectorError, SnapshotChanged, StorageError
 from igloo_tpu.exec.batch import schema_from_arrow
+from igloo_tpu.storage import local_store, quarantine
+from igloo_tpu.storage import snapshot as _snapshot
 from igloo_tpu.types import Schema
 
 log = logging.getLogger("igloo_tpu.iceberg")
@@ -42,14 +44,21 @@ class IcebergTable:
         # providers are shared by plan/expression copies (see copy_plan)
         return self
 
-    def __init__(self, path: str, snapshot_id: Optional[int] = None):
+    def __init__(self, path: str, snapshot_id: Optional[int] = None,
+                 store=None):
         self.path = path.rstrip("/")
         self.snapshot_id = snapshot_id
+        # data-file reads route through the object store (docs/storage.md);
+        # metadata (version JSON, Avro manifests) stays on the local
+        # filesystem — iceberg commits re-WRITE metadata versions, so the
+        # etag-pinned window is the data files the chosen snapshot names
+        self._store = store if store is not None else local_store()
         self._files = self._resolve_data_files()
         if not self._files:
             raise ConnectorError(
                 f"iceberg table at {path} has no data files")
-        self._arrow_schema = pq.read_schema(self._files[0])
+        self._arrow_schema = pq.read_schema(
+            self._store.open_input(self._files[0], table=self.path))
         self._schema = schema_from_arrow(self._arrow_schema)
 
     # --- metadata resolution ---
@@ -147,14 +156,20 @@ class IcebergTable:
     # --- provider protocol ---
 
     def snapshot(self):
-        """Iceberg snapshot token: metadata file + data files (paths, mtimes,
-        sizes). A new table commit writes a new metadata version, changing the
+        """Iceberg snapshot token: metadata file + data files (store etags).
+        A new table commit writes a new metadata version, changing the
         token; _refresh() here AND in read()/read_partition() keeps the served
-        file list consistent with the version the token is computed from."""
-        from igloo_tpu.connectors.parquet import file_snapshot
+        file list consistent with the version the token is computed from.
+        Inside a query's pinned scope the first call pins token + per-file
+        etags (storage/snapshot.py) — the whole query reads ONE commit."""
+        tok, _etags = _snapshot.pin(self, self._snapshot_now)
+        return tok
+
+    def _snapshot_now(self) -> tuple:
         self._refresh()
         meta = self._metadata_file()
-        return file_snapshot(([meta] if meta else []) + self._files)
+        return self._store.snapshot_token(
+            ([meta] if meta else []) + self._files)
 
     def _refresh(self) -> None:
         """Re-resolve data files when the table's metadata version moved (a
@@ -170,23 +185,47 @@ class IcebergTable:
     def num_partitions(self) -> int:
         return len(self._files)
 
+    def _maybe_refresh(self) -> None:
+        # inside a pinned query scope the file list is already the one the
+        # pinned snapshot resolved — re-resolving mid-query would let a
+        # concurrent commit swap in files the pin never covered
+        if _snapshot.pinned_etags(self) is None:
+            self._refresh()
+
     def read(self, projection: Optional[list[str]] = None,
              filters: Optional[list] = None) -> pa.Table:
-        self._refresh()
+        self._maybe_refresh()
         tables = [self._read_file(f, projection, filters) for f in self._files]
         return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
 
     def read_partition(self, index, projection=None, filters=None) -> pa.Table:
-        self._refresh()
+        self._maybe_refresh()
         return self._read_file(self._files[index], projection, filters)
 
     def _read_file(self, path, projection, filters) -> pa.Table:
+        pins = _snapshot.pinned_etags(self)
+        want = pins.get(path) if pins is not None else None
         try:
-            pf = pq.ParquetFile(path)
+            fh = self._store.open_input(path, want_etag=want,
+                                        table=self.path)
+        except FileNotFoundError:
+            # an expired/compacted data file: a commit happened — the typed
+            # snapshot change the engine converts into one re-plan
+            raise SnapshotChanged(
+                f"iceberg data file vanished: {path} (table {self.path})",
+                table=self.path, key=path) from None
+        quarantine.check(path, fh.etag, -1, table=self.path)
+        try:
+            pf = pq.ParquetFile(fh)
             groups = _prune_row_groups(pf, filters)
             if groups is None:
                 return pf.read(columns=projection)
             return pf.read_row_groups(groups, columns=projection)
-        except Exception as ex:
+        except (SnapshotChanged, StorageError):
+            raise
+        except MemoryError as ex:   # transient pressure, never quarantined
             raise ConnectorError(
                 f"iceberg parquet read failed for {path}: {ex}") from None
+        except Exception as ex:
+            raise quarantine.record(path, fh.etag, -1, str(ex),
+                                    table=self.path) from None

@@ -5,6 +5,14 @@ Replaces the reference's ParquetScanExec (crates/engine/src/operators/parquet_sc
 design: decode host-side via pyarrow's C++ Parquet reader with column projection
 AND row-group pruning from pushed-down predicates (min/max statistics), then one
 `device_put` of whole columns into HBM (exec/batch.from_arrow).
+
+Every byte comes through the object-store layer (igloo_tpu/storage,
+docs/storage.md): reads are policy-retried ranged GETs verified against the
+query's pinned snapshot etags (a source mutated mid-query raises
+`SnapshotChanged` → ONE engine re-plan, never a torn result), a vanished
+file is a snapshot change rather than a raw FileNotFoundError, and a row
+group whose bytes no longer parse is quarantined behind a typed
+`CorruptObjectError` naming file + row group.
 """
 from __future__ import annotations
 
@@ -16,14 +24,17 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from igloo_tpu.errors import ConnectorError
+from igloo_tpu.errors import ConnectorError, SnapshotChanged, StorageError
 from igloo_tpu.exec.batch import schema_from_arrow
 from igloo_tpu.plan import expr as E
+from igloo_tpu.storage import local_store, quarantine
+from igloo_tpu.storage import snapshot as _snapshot
 from igloo_tpu.types import Schema
 
 
 class ParquetTable:
-    """One file, a directory of files, or a glob pattern."""
+    """One file, a directory of files, or a glob pattern — optionally on an
+    explicit `store` (any storage.ObjectStore; default local filesystem)."""
 
     # deterministic file/row-group order -> scans may be cached per column
     stable_row_order = True
@@ -31,16 +42,18 @@ class ParquetTable:
     # device lanes (device-memory budgets scale estimates by this)
     bytes_expansion = 3.5
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, store=None):
         import threading
         self.path = path
+        self._store = store if store is not None else local_store()
         self._parts = None  # lazy (file, row_group) partition index
         self._plock = threading.Lock()  # guards _files/_parts (Flight threads)
-        self._files = _expand(path)
+        self._files = _expand_store(self._store, path)
         if not self._files:
             raise ConnectorError(f"no parquet files at {path}")
         try:
-            self._arrow_schema = pq.read_schema(self._files[0])
+            self._arrow_schema = pq.read_schema(
+                self._store.open_input(self._files[0], table=path))
         except Exception as ex:  # corrupt/fake file (reference gap G8)
             raise ConnectorError(f"cannot read parquet schema from "
                                  f"{self._files[0]}: {ex}") from None
@@ -57,16 +70,22 @@ class ParquetTable:
         return self
 
     def snapshot(self):
-        """Cache/CDC token: changes when any underlying file changes on disk
-        (re-globs directory/glob paths so added files are seen — and drops the
-        stale partition index when the file set moved)."""
-        files = _expand(self.path)
+        """Cache/CDC token: changes when any underlying file's store etag
+        changes (re-lists directory/glob paths so added files are seen — and
+        drops the stale partition index when the file set moved). Inside a
+        query's pinned scope (storage/snapshot.py) the first call pins the
+        token AND the per-file etags every ranged read then verifies."""
+        tok, _etags = _snapshot.pin(self, self._snapshot_now)
+        return tok
+
+    def _snapshot_now(self) -> tuple:
+        files = _expand_store(self._store, self.path)
         with self._plock:
             if files and files != self._files:
                 self._files = files
                 self._parts = None
             files = list(self._files)
-        return file_snapshot(files)
+        return self._store.snapshot_token(files)
 
     def _partition_index(self) -> list[tuple[str, int]]:
         """(file, row_group) pairs — the scan's parallel/chunking unit. Row
@@ -74,13 +93,20 @@ class ParquetTable:
         across workers / chunks (reference analog: fixed 1024-row read batches,
         parquet_scan.rs:54, which never leave the single stream). Lock-guarded:
         Flight serves fragments on concurrent threads, and snapshot() may drop
-        the index when the file set moves."""
+        the index when the file set moves. A file that vanishes between the
+        list and the metadata read is a SNAPSHOT CHANGE, not a crash: it is
+        dropped here, and the pinned-etag verification on the surviving reads
+        tells the engine to re-plan."""
         with self._plock:
             if self._parts is None:
                 parts: list[tuple[str, int]] = []
                 for f in self._files:
                     try:
-                        n = pq.ParquetFile(f).metadata.num_row_groups
+                        n = pq.ParquetFile(
+                            self._store.open_input(f, table=self.path)
+                        ).metadata.num_row_groups
+                    except (FileNotFoundError, SnapshotChanged):
+                        continue  # vanished between list and head
                     except Exception:
                         n = 1
                     parts.extend((f, i) for i in range(max(n, 1)))
@@ -101,7 +127,22 @@ class ParquetTable:
         return hashlib.sha1(repr(parts).encode()).hexdigest()
 
     def estimated_bytes(self) -> Optional[int]:
-        return files_bytes(self._files)
+        return self._store.files_bytes(self._files)
+
+    def _open(self, path: str):
+        """Open one data file for verified ranged reads: the etag pinned by
+        this query's snapshot() (if any) is enforced at open and on every
+        read; a vanished file maps to SnapshotChanged — the typed signal the
+        engine converts into one bounded re-plan."""
+        pins = _snapshot.pinned_etags(self)
+        want = pins.get(path) if pins is not None else None
+        try:
+            return self._store.open_input(path, want_etag=want,
+                                          table=self.path)
+        except FileNotFoundError:
+            raise SnapshotChanged(
+                f"parquet file vanished: {path} (table {self.path})",
+                table=self.path, key=path) from None
 
     def read(self, projection: Optional[list[str]] = None,
              filters: Optional[list] = None) -> pa.Table:
@@ -110,36 +151,65 @@ class ParquetTable:
 
     def read_partition(self, index: int, projection=None, filters=None) -> pa.Table:
         try:
-            # inside the try: the index is mutable (snapshot() re-globs), so a
-            # planned partition id can go stale mid-query — surface it as a
-            # ConnectorError, not a bare IndexError
+            # the index is mutable (snapshot() re-lists): a planned partition
+            # id that is now out of range means the file set shrank — a
+            # SNAPSHOT CHANGE the engine converts into one bounded re-plan,
+            # not a bare IndexError
             path, rg = self._partition_index()[index]
-            pf = pq.ParquetFile(path)
+        except IndexError:
+            raise SnapshotChanged(
+                f"parquet partition {index} out of range for {self.path} "
+                "(source files moved/replaced)", table=self.path) from None
+        fh = self._open(path)
+        quarantine.check(path, fh.etag, rg, table=self.path)
+        try:
+            pf = pq.ParquetFile(fh)
+            if rg >= pf.metadata.num_row_groups:
+                # the file shrank under an unpinned read: a snapshot change
+                # (never corruption — the bytes parse fine)
+                raise SnapshotChanged(
+                    f"parquet file {path} has {pf.metadata.num_row_groups} "
+                    f"row groups, planned index {rg} (table {self.path})",
+                    table=self.path, key=path)
             groups = _prune_row_groups(pf, filters)
             if groups is not None and rg not in groups:
                 return pf.schema_arrow.empty_table() if projection is None \
                     else pf.schema_arrow.empty_table().select(projection)
             return pf.read_row_groups([rg], columns=projection)
-        except ConnectorError:
-            raise
-        except Exception as ex:
+        except (SnapshotChanged, StorageError):
+            raise  # already typed (mutation / retries spent) — never corrupt
+        except MemoryError as ex:
+            # transient pressure (pa.ArrowMemoryError subclasses this), not
+            # bad bytes: quarantining would brick the row group for the
+            # process lifetime — surface per-query instead
             raise ConnectorError(
                 f"parquet partition {index} read failed for {self.path}: "
                 f"{ex}") from None
+        except Exception as ex:
+            # the store served the pinned bytes and they did not parse:
+            # corruption, fatal for THIS (file, row group) — quarantined
+            raise quarantine.record(path, fh.etag, rg, str(ex),
+                                    table=self.path) from None
 
     def _read_file(self, path: str, projection, filters) -> pa.Table:
+        fh = self._open(path)
+        quarantine.check(path, fh.etag, -1, table=self.path)
         try:
-            pf = pq.ParquetFile(path)
+            pf = pq.ParquetFile(fh)
             groups = _prune_row_groups(pf, filters)
             if groups is None:
                 t = pf.read(columns=projection)
             else:
                 t = pf.read_row_groups(groups, columns=projection)
             return t
-        except ConnectorError:
+        except (SnapshotChanged, StorageError):
             raise
+        except MemoryError as ex:   # transient pressure, never quarantined
+            raise ConnectorError(
+                f"parquet read failed for {path}: {ex}") from None
         except Exception as ex:
-            raise ConnectorError(f"parquet read failed for {path}: {ex}") from None
+            raise quarantine.record(path, fh.etag, -1, str(ex),
+                                    table=self.path) from None
 
 
 def files_bytes(files: list[str]) -> Optional[int]:
@@ -170,6 +240,16 @@ def _expand(path: str) -> list[str]:
     if any(ch in path for ch in "*?["):
         return sorted(_glob.glob(path))
     return [path] if os.path.exists(path) else []
+
+
+def _expand_store(store, path: str, suffix: str = ".parquet") -> list[str]:
+    """File set for `path` on any ObjectStore backend: a plain key lists
+    itself, a glob matches, a prefix/directory lists recursively filtered
+    to `suffix` (the LocalStore case reproduces `_expand` exactly)."""
+    keys = store.list_prefix(path)
+    if keys == [path] or any(ch in path for ch in "*?["):
+        return sorted(keys)   # plain key or explicit glob: take as matched
+    return sorted(k for k in keys if k.endswith(suffix))
 
 
 def _prune_row_groups(pf: pq.ParquetFile, filters) -> Optional[list[int]]:

@@ -22,7 +22,9 @@ import pyarrow as pa
 
 from igloo_tpu import types as T
 from igloo_tpu.catalog import Catalog, MemTable, TableProvider
-from igloo_tpu.errors import CatalogError, IglooError, PlanError
+from igloo_tpu.errors import CatalogError, IglooError, PlanError, \
+    SnapshotChanged
+from igloo_tpu.storage import snapshot as storage_snapshot
 from igloo_tpu.exec.executor import Executor
 from igloo_tpu.plan import logical as L
 from igloo_tpu.plan.binder import Binder
@@ -196,8 +198,13 @@ class QueryEngine:
                 # per-node wall time, compile/execute split, transfer bytes,
                 # and GRACE per-partition rollups (docs/observability.md)
                 peak0 = stats.device_peak_hbm_bytes()
+
+                def rebind() -> L.LogicalPlan:
+                    b = Binder(self.catalog, udfs=self.udfs).bind(stmt.query)
+                    return optimize(b)
+
                 with stats.collect(sql, detail=True) as qs:
-                    table = self._execute_plan(plan)
+                    table, plan = self._execute_pinned(plan, rebind)
                     qs.rows = table.num_rows
                 self._harvest_adaptive(qs, plan, peak_hbm0=peak0)
                 text += "\n-- actual (operator tree):\n"
@@ -218,6 +225,17 @@ class QueryEngine:
                 if cc_hit or cc_miss:
                     text += (f"\n-- compile_cache: hits={cc_hit} "
                              f"misses={cc_miss}")
+                # object-store attribution (docs/storage.md): ranged reads,
+                # policy retries, prefetcher hits, and whether the query
+                # paid a snapshot re-plan
+                sreads = delta.get("storage.read", 0)
+                sretry = delta.get("storage.snapshot_retry", 0)
+                if sreads or sretry:
+                    text += (f"\n-- storage: reads={sreads} "
+                             f"retries={delta.get('storage.retry', 0)} "
+                             f"prefetch_hits="
+                             f"{delta.get('storage.prefetch_hit', 0)} "
+                             f"snapshot_retries={sretry}")
                 # local mesh-tier attribution: did the sharded executor run,
                 # across how many chips, at what per-device lane width (the
                 # chip-level half of the two-level topology,
@@ -438,22 +456,53 @@ class QueryEngine:
                 qs.tier = "sharded" if mesh is not None else "device"
             return self._executor().execute_to_arrow(plan)
 
+    def _execute_pinned(self, plan: L.LogicalPlan, rebind):
+        """Execute under a pinned storage snapshot (storage/snapshot.py):
+        every provider's first snapshot() pins the etags all ranged reads
+        then verify. A source mutated mid-query raises SnapshotChanged; the
+        engine converts it into exactly ONE re-plan at the new snapshot
+        (counter `storage.snapshot_retry`) — caches for the changed table
+        dropped, plan re-bound via `rebind()`, execution re-pinned. A
+        second mutation during the retry propagates: a source churning
+        faster than the query can run is an error, not a livelock."""
+        try:
+            with storage_snapshot.pinned_scope():
+                return self._execute_plan(plan), plan
+        except SnapshotChanged as ex:
+            tracing.counter("storage.snapshot_retry")
+            tracing.log.warning(
+                "storage: snapshot changed mid-query (%s); re-planning once",
+                ex)
+            if ex.table:
+                self.batch_cache.invalidate_table(ex.table)
+                self.host_cache.invalidate_table(ex.table)
+                self.result_cache.invalidate_table(ex.table)
+            plan = rebind()
+            with storage_snapshot.pinned_scope():
+                return self._execute_plan(plan), plan
+
     def _run_select(self, stmt: A.SelectStmt, want_plan: bool = False):
         from igloo_tpu.exec.result_cache import plan_cache_key
-        with span("bind+optimize"):
-            bound = Binder(self.catalog, udfs=self.udfs).bind(stmt)
-            plan = optimize(bound)
-        rkey = plan_cache_key(plan)
-        if rkey is not None:
-            hit = self.result_cache.get(rkey)
+        state: dict = {}
+
+        def bind() -> L.LogicalPlan:
+            with span("bind+optimize"):
+                bound = Binder(self.catalog, udfs=self.udfs).bind(stmt)
+                p = optimize(bound)
+            state["rkey"] = plan_cache_key(p)
+            return p
+
+        plan = bind()
+        if state["rkey"] is not None:
+            hit = self.result_cache.get(state["rkey"])
             if hit is not None:
                 qs = stats.current()
                 if qs is not None:
                     qs.tier = "result_cache"
                 return (hit, plan) if want_plan else hit
-        table = self._execute_plan(plan)
-        if rkey is not None:
-            self.result_cache.put(rkey, table)
+        table, plan = self._execute_pinned(plan, bind)
+        if state["rkey"] is not None:
+            self.result_cache.put(state["rkey"], table)
         if want_plan:
             return table, plan
         return table

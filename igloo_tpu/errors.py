@@ -30,6 +30,36 @@ class ConnectorError(IglooError):
     """Source-format failures (Parquet/CSV/Iceberg/JDBC-ish)."""
 
 
+class StorageError(ConnectorError):
+    """Object-store I/O failure (igloo_tpu/storage): a read/head/list/put
+    that stayed failed after its StoragePolicy retry budget, or was
+    classified fatal outright. Subclasses ConnectorError so every existing
+    source-failure handler treats it as one."""
+
+
+class SnapshotChanged(StorageError):
+    """The source mutated under a running query: a pinned etag/version no
+    longer matches what the store serves (or a pinned file vanished). The
+    engine converts this into ONE bounded re-plan at the new snapshot
+    (counter `storage.snapshot_retry`) instead of returning a torn result."""
+
+    def __init__(self, msg: str, table: str = "", key: str = ""):
+        super().__init__(msg)
+        self.table = table
+        self.key = key
+
+
+class CorruptObjectError(StorageError):
+    """Checksum/parse failure pinned to one object (and row group): fatal
+    for that object, negative-cached by the quarantine registry so the
+    engine never re-reads known-bad bytes (counter `storage.corrupt`)."""
+
+    def __init__(self, msg: str, key: str = "", row_group: int = -1):
+        super().__init__(msg)
+        self.key = key
+        self.row_group = row_group
+
+
 class TransportError(IglooError):
     """RPC / serialization failures in the distributed tier."""
 

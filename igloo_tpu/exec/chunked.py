@@ -126,12 +126,34 @@ class LocalChunkExecutor:
                 return base.get(name)
 
         overlay = _Overlay()
+        # deserialize what we can upfront (fragments referencing earlier
+        # results resolve later) and enqueue every partitioned scan read, in
+        # fragment order, on the storage prefetcher: the reader thread
+        # decodes chunk k+1's row groups while chunk k computes on device
+        # (docs/storage.md#prefetch; IGLOO_STORAGE_PREFETCH=0 kills it)
+        from igloo_tpu.storage import prefetch as _prefetch
+        plans: dict[str, L.LogicalPlan] = {}
+        items: list[tuple] = []
+        for f in frags:
+            try:
+                p = serde.plan_from_json(f.plan, overlay)
+            except Exception:
+                continue  # needs a not-yet-computed fragment result
+            plans[f.id] = p
+            for sc in L.walk_plan(p):
+                if isinstance(sc, L.Scan) and sc.provider is not None \
+                        and sc.partition:
+                    items.extend((sc.provider, i, sc.projection,
+                                  sc.pushed_filters) for i in sc.partition)
         # fragments are appended children-first, so sequential order is
         # dependency-safe; chunk results are host Arrow (partials are small)
-        with stats.op("ChunkedExecution", chunks=self.chunks,
-                      fragments=len(frags)):
+        with _prefetch.scan_prefetch(items), \
+                stats.op("ChunkedExecution", chunks=self.chunks,
+                         fragments=len(frags)):
             for i, f in enumerate(frags):
-                p = serde.plan_from_json(f.plan, overlay)
+                p = plans.get(f.id)
+                if p is None:
+                    p = serde.plan_from_json(f.plan, overlay)
                 ex = Executor(self._jit_cache, use_jit=self._use_jit,
                               batch_cache=self._batch_cache)
                 with stats.op(f"Chunk[{i}]" if i < len(frags) - 1

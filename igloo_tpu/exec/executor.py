@@ -69,7 +69,13 @@ def read_scan_table(plan: L.Scan, projection=_SENTINEL) -> pa.Table:
     1024-row batches but custom operators are single-stream) with explicit
     provider partitions the distributed planner / chunked executor slice.
     `projection` overrides the plan's (the column-granular scan cache reads
-    only the columns it is missing)."""
+    only the columns it is missing).
+
+    Partitioned reads first consult the query's storage prefetcher
+    (storage/prefetch.py, installed by the chunked/GRACE feeds): a partition
+    the reader thread already decoded is handed over without touching the
+    source (counter `storage.prefetch_hit`); anything else reads
+    synchronously."""
     proj = plan.projection if projection is _SENTINEL else projection
     if plan.partition is None:
         return plan.provider.read(projection=proj,
@@ -78,13 +84,26 @@ def read_scan_table(plan: L.Scan, projection=_SENTINEL) -> pa.Table:
     if plan.partition_token is not None and tok_fn is not None:
         cur = tok_fn()
         if cur != plan.partition_token:
-            from igloo_tpu.errors import ConnectorError
-            raise ConnectorError(
+            from igloo_tpu.errors import SnapshotChanged
+            raise SnapshotChanged(
                 f"partition index for {plan.table} changed since planning "
-                "(source files moved/replaced); re-plan the query")
-    parts = [plan.provider.read_partition(i, projection=proj,
-                                          filters=plan.pushed_filters)
-             for i in plan.partition]
+                "(source files moved/replaced)", table=plan.table)
+    from igloo_tpu.storage import prefetch as _prefetch
+    pf = _prefetch.current()
+    parts = []
+    for i in plan.partition:
+        t = pf.take(plan.provider, i, plan.pushed_filters) \
+            if pf is not None else None
+        if t is not None and proj is not None:
+            try:
+                # prefetched at the scan's planned projection; narrow here
+                t = t.select(proj)
+            except KeyError:
+                t = None   # projection drifted: fall back to a sync read
+        if t is None:
+            t = plan.provider.read_partition(i, projection=proj,
+                                             filters=plan.pushed_filters)
+        parts.append(t)
     return pa.concat_tables(parts) if parts else \
         plan.provider.read(projection=proj,
                            filters=plan.pushed_filters).slice(0, 0)

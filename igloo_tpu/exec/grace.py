@@ -767,9 +767,17 @@ class GraceJoinExecutor:
         if sc is not None and sc.provider is not None and \
                 sc.partition is None and np_ > 1:
             from igloo_tpu.cluster.fragment import _with_partition
-            for i in range(np_):
-                yield self._executor().execute_to_arrow(
-                    _with_partition(node, (i,)))
+            from igloo_tpu.storage import prefetch as _prefetch
+            # feed the partition stride through the storage prefetcher: the
+            # reader thread decodes row group i+1 while partition i's plan
+            # runs on device (docs/storage.md#prefetch) — the cold-scan half
+            # of the double-buffer this loop feeds
+            items = [(sc.provider, i, sc.projection, sc.pushed_filters)
+                     for i in range(np_)]
+            with _prefetch.scan_prefetch(items):
+                for i in range(np_):
+                    yield self._executor().execute_to_arrow(
+                        _with_partition(node, (i,)))
             return
         yield self._leaf_routed(node, depth)
 

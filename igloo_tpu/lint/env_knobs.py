@@ -52,11 +52,12 @@ _HELPER_SPECS = {"_env_int": (0, 2)}
 
 #: twin section -> config.py dataclass holding its keys
 _SECTION_CLASSES = {"rpc": "RpcConfig", "serving": "ServingConfig",
+                    "storage": "StorageConfig",
                     "cluster": "ClusterConfig",
                     "distributed": "DistributedConfig", "engine": "Config"}
 
 #: config sections whose every field must have a documented env twin
-_TWINNED_SECTIONS = ("rpc", "serving")
+_TWINNED_SECTIONS = ("rpc", "serving", "storage")
 
 #: marker for "read with no inline default" (derived/unset)
 _NO_DEFAULT = object()

@@ -1,0 +1,20 @@
+"""Resilient object-store storage layer (docs/storage.md).
+
+The subsystem under every file-backed connector: an S3/GCS-shaped
+`ObjectStore` (ranged GETs, etag heads, listing) with every operation run
+under a retry/timeout `StoragePolicy`, per-query snapshot PINNING so a
+source mutated mid-query raises a typed `SnapshotChanged` (one bounded
+engine re-plan) instead of a torn result, a corruption QUARANTINE that
+negative-caches bad row groups behind typed errors, and an async row-group
+PREFETCHER that overlaps cold-scan I/O with device compute under a bytes
+budget. Failure modes are deterministically testable through the
+`storage.*` points of the IGLOO_FAULTS grammar (cluster/faults.py).
+"""
+from igloo_tpu.storage.policy import (            # noqa: F401
+    StoragePolicy, default_policy, policy_from_env, set_default_policy,
+    transient,
+)
+from igloo_tpu.storage.store import (             # noqa: F401
+    LocalStore, MemoryStore, ObjectFile, ObjectMeta, ObjectStore,
+    local_store,
+)

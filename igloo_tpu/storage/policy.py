@@ -1,0 +1,111 @@
+"""StoragePolicy: the failure budget every object-store operation runs under.
+
+The `RpcPolicy` idiom from cluster/rpc.py applied to storage I/O: per-attempt
+timeouts, a bounded retry budget with exponential backoff + jitter, and an
+explicit transient-vs-fatal classification so a blip against a recovering
+backend is absorbed while a genuinely failed read surfaces once, typed.
+
+Knobs: `IGLOO_STORAGE_*` env vars or the `[storage]` config section
+(docs/storage.md#policy) — env wins per field, exactly like `[rpc]`.
+
+Classification contract (`transient()`):
+
+- RETRYABLE: timeouts, connection resets, generic OSErrors (a flaky NFS
+  mount, an S3 500), and the fault injector's FlightUnavailableError — the
+  next attempt may see a healthy backend.
+- FATAL: `FileNotFoundError` (a vanished object is a *snapshot change*, not
+  a blip — retrying cannot bring the old bytes back), `SnapshotChanged` /
+  `CorruptObjectError` (already classified upstream), and anything else —
+  retrying a failed parse would mask bugs as flakes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from igloo_tpu.errors import StorageError
+
+
+@dataclass(frozen=True)
+class StoragePolicy:
+    """Failure budget for one storage operation. Immutable — derive variants
+    with `with_(...)`."""
+    connect_timeout_s: float = 5.0     # backend/session establishment bound
+    read_timeout_s: float = 60.0       # per-attempt bound on one ranged read
+    retries: int = 3                   # transient-failure budget (attempts-1)
+    backoff_base_s: float = 0.02
+    backoff_max_s: float = 1.0
+    backoff_jitter: float = 0.25       # +-fraction of the backoff step
+
+    def with_(self, **kw) -> "StoragePolicy":
+        return dataclasses.replace(self, **kw)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Sleep before retry `attempt` (1-based): exponential, capped,
+        jittered — a wave of readers against one recovering store spreads
+        out instead of stampeding (same shape as RpcPolicy.backoff_s)."""
+        import random
+        base = min(self.backoff_base_s * (2 ** (attempt - 1)),
+                   self.backoff_max_s)
+        if self.backoff_jitter <= 0:
+            return base
+        return base * (1.0 + random.uniform(-self.backoff_jitter,
+                                            self.backoff_jitter))
+
+
+_ENV_FIELDS = (("connect_timeout_s", "IGLOO_STORAGE_CONNECT_TIMEOUT_S"),
+               ("read_timeout_s", "IGLOO_STORAGE_READ_TIMEOUT_S"),
+               ("retries", "IGLOO_STORAGE_RETRIES"),
+               ("backoff_base_s", "IGLOO_STORAGE_BACKOFF_BASE_S"),
+               ("backoff_max_s", "IGLOO_STORAGE_BACKOFF_MAX_S"),
+               ("backoff_jitter", "IGLOO_STORAGE_BACKOFF_JITTER"))
+
+
+def policy_from_env(base: Optional[StoragePolicy] = None) -> StoragePolicy:
+    base = base or StoragePolicy()
+    kw = {}
+    for fld, env in _ENV_FIELDS:
+        v = os.environ.get(env)
+        if v:
+            kw[fld] = int(v) if fld == "retries" else float(v)
+    return base.with_(**kw) if kw else base
+
+
+_default_policy: Optional[StoragePolicy] = None
+
+
+def default_policy() -> StoragePolicy:
+    global _default_policy
+    if _default_policy is None:
+        _default_policy = policy_from_env()
+    return _default_policy
+
+
+def set_default_policy(policy: Optional[StoragePolicy]) -> None:
+    """Install a process-wide default (config loading); None re-reads env."""
+    global _default_policy
+    _default_policy = policy
+
+
+def transient(ex: BaseException) -> bool:
+    """Transient-vs-fatal classification (module docstring for the
+    contract). StorageError covers SnapshotChanged/CorruptObjectError —
+    both already classified, never retried."""
+    if isinstance(ex, (StorageError, FileNotFoundError, IsADirectoryError,
+                       PermissionError)):
+        return False
+    if isinstance(ex, (TimeoutError, ConnectionError)):
+        return True
+    # the fault injector raises FlightUnavailableError (its retryable
+    # class); resolve lazily so storage never forces pyarrow.flight in
+    try:
+        import pyarrow.flight as flight
+        if isinstance(ex, flight.FlightUnavailableError):
+            return True
+        if isinstance(ex, flight.FlightError):
+            return False
+    except ImportError:  # pragma: no cover - pyarrow always ships flight
+        pass
+    return isinstance(ex, OSError)

@@ -1,0 +1,275 @@
+"""Async row-group prefetcher: cold scans overlap device compute.
+
+The chunked tier executes one budget-sized fragment at a time; each
+fragment's scan reads its provider partitions (parquet row groups)
+synchronously, so on a cold source the device idles for the whole decode.
+The GRACE leaf feed strides partitions the same way. This module puts ONE
+reader thread ahead of that consumption (Theseus' premise, PAPERS.md:
+overlapping I/O with compute beats faster kernels at scale):
+
+- the executor enqueues the upcoming (provider, partition) reads in
+  consumption order (`ScanPrefetcher.enqueue`);
+- the reader thread decodes them ahead under a BYTES budget
+  (`IGLOO_STORAGE_PREFETCH_BYTES`, default 256 MB of buffered Arrow) —
+  it parks when the buffer is full and resumes as the consumer drains;
+- `read_scan_table` (exec/executor.py) asks `take()` before reading
+  synchronously: a ready partition is a `storage.prefetch_hit`, an
+  in-flight one is waited for (histogram `storage.prefetch_wait_s`), an
+  unknown one is a miss answered synchronously;
+- the thread ADOPTS the query's stats/trace/pin contexts
+  (utils/stats.capture, storage/snapshot.capture), so its reads land in
+  the right query's counters and its `storage.prefetch` spans visibly
+  overlap the consumer's compute spans on the Perfetto timeline — and its
+  etag verification runs against the query's pinned snapshot;
+- teardown is prompt: `close()` (always called — context manager), a
+  tripped `CancelToken`, or an expired deadline stops the thread at the
+  next partition boundary and drops every buffered byte.
+
+Kill switch: `IGLOO_STORAGE_PREFETCH=0` routes everything through the
+synchronous path (bit-identical results, no thread).
+
+A prefetch read that FAILS parks a miss marker instead of an exception:
+the consumer re-reads synchronously and the real error (typed by the
+storage layer) surfaces on the query thread, where the engine's
+SnapshotChanged re-plan and the quarantine ladder already handle it.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Optional
+
+from igloo_tpu.storage import snapshot as _snapshot
+from igloo_tpu.utils import stats, tracing
+
+PREFETCH_ENV = "IGLOO_STORAGE_PREFETCH"
+BUDGET_ENV = "IGLOO_STORAGE_PREFETCH_BYTES"
+
+_tls = threading.local()
+
+_cfg_enabled: Optional[bool] = None
+_cfg_budget: Optional[int] = None
+
+
+def configure(enabled: Optional[bool], budget_bytes: Optional[int]) -> None:
+    """[storage] config twins for the env knobs (env wins, like [rpc])."""
+    global _cfg_enabled, _cfg_budget
+    _cfg_enabled = enabled
+    _cfg_budget = budget_bytes
+
+
+def enabled() -> bool:
+    v = os.environ.get(PREFETCH_ENV)
+    if v:
+        return v != "0"
+    return _cfg_enabled if _cfg_enabled is not None else True
+
+
+def budget_bytes() -> int:
+    v = os.environ.get(BUDGET_ENV)
+    if v:
+        return int(v)
+    if _cfg_budget is not None:
+        return int(_cfg_budget)
+    return 268435456  # 256 MB of buffered decoded Arrow
+
+
+def current() -> Optional["ScanPrefetcher"]:
+    """The prefetcher installed on this thread (scan_prefetch scope)."""
+    return getattr(_tls, "prefetcher", None)
+
+
+def _filter_fp(filters) -> str:
+    return "|".join(repr(e) for e in filters) if filters else ""
+
+
+class ScanPrefetcher:
+    """One query's read-ahead pipeline (module docstring). Single reader
+    thread, single consumer thread; keys are (provider id, partition,
+    filter fingerprint) — the projection is NOT in the key: the reader
+    fetches the scan's planned projection and the consumer narrows
+    (`take()` returns the full prefetched table; read_scan_table selects)."""
+
+    def __init__(self, budget: Optional[int] = None, cancel=None,
+                 deadline: Optional[float] = None):
+        self.budget = budget if budget is not None else budget_bytes()
+        self._cancel = cancel
+        self._deadline = deadline
+        self._cv = threading.Condition()
+        self._queue: list[tuple] = []       # pending keys, consumption order
+        self._work: dict = {}               # key -> (provider, args)
+        self._ready: dict = {}              # key -> pa.Table | None (failed)
+        self._running: Optional[tuple] = None
+        self._buffered = 0
+        self._parked = False   # reader waiting at the bytes budget
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._sctx = stats.capture()
+        self._pins = _snapshot.capture()
+
+    # --- producer side (executor wiring) --------------------------------
+
+    def enqueue(self, provider, index: int, projection, filters) -> None:
+        # the provider OBJECT is part of the key (identity hash, reference
+        # held): a freed provider can never alias a new one's slot
+        key = (provider, int(index), _filter_fp(filters))
+        with self._cv:
+            if key in self._work or key in self._ready:
+                return
+            self._work[key] = (projection, filters)
+            self._queue.append(key)
+            self._cv.notify_all()
+
+    def start(self) -> "ScanPrefetcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="igloo-storage-prefetch")
+            self._thread.start()
+        return self
+
+    # --- consumer side ---------------------------------------------------
+
+    def take(self, provider, index: int, filters):
+        """The prefetched table for (provider, partition), or None on a
+        miss (never queued, failed, stolen back, or torn down) — the
+        caller then reads synchronously. An in-flight read is waited for
+        (a running reader finishes), and so is a queued key while the
+        reader is making progress; but once the reader PARKS at the bytes
+        budget, queued keys are STOLEN back as misses — the buffer may be
+        full of tables no consumer will ever drain (warm scans served
+        from the HBM cache never call take), and waiting on a parked
+        reader would deadlock the query."""
+        key = (provider, int(index), _filter_fp(filters))
+        with self._cv:
+            t0 = None
+            while True:
+                if key in self._ready:
+                    tbl = self._ready.pop(key)
+                    if tbl is not None:
+                        self._buffered -= tbl.nbytes
+                        tracing.gauge("storage.prefetch_buffered_bytes",
+                                      self._buffered)
+                        tracing.counter("storage.prefetch_hit")
+                    else:
+                        # the reader's read FAILED: a miss (the sync
+                        # re-read surfaces the typed error)
+                        tracing.counter("storage.prefetch_miss")
+                    if t0 is not None:
+                        tracing.histogram("storage.prefetch_wait_s",
+                                          time.perf_counter() - t0)
+                    self._cv.notify_all()
+                    return tbl
+                pending = self._running == key or \
+                    (key in self._work and not self._parked)
+                if pending and not self._stop:
+                    if t0 is None:
+                        t0 = time.perf_counter()
+                    self._cv.wait(0.05)
+                    continue
+                if key in self._work:   # parked reader: steal the key back
+                    del self._work[key]
+                    self._queue.remove(key)
+                tracing.counter("storage.prefetch_miss")
+                return None
+
+    def close(self) -> None:
+        """Prompt teardown: stop the reader at the next boundary, drop the
+        buffer, join. Idempotent."""
+        with self._cv:
+            self._stop = True
+            self._queue.clear()
+            self._work.clear()
+            self._ready.clear()
+            self._buffered = 0
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        tracing.gauge("storage.prefetch_buffered_bytes", 0)
+
+    # --- reader thread ----------------------------------------------------
+
+    def _expired(self) -> bool:
+        if self._cancel is not None and \
+                getattr(self._cancel, "cancelled", False):
+            return True
+        return self._deadline is not None and time.time() >= self._deadline
+
+    def _loop(self) -> None:
+        with stats.adopt(self._sctx), _snapshot.adopt(self._pins):
+            while True:
+                with self._cv:
+                    while not self._stop and not self._queue and \
+                            not self._expired():
+                        self._cv.wait(0.05)
+                    # park while the buffer is over budget: the bytes bound
+                    # is the whole point — read-ahead must not grow past
+                    # it. The flag lets take() STEAL queued keys instead of
+                    # waiting on a reader that may never resume (warm
+                    # cache-served scans never drain their tables)
+                    while not self._stop and self._queue and \
+                            self._buffered >= self.budget and \
+                            not self._expired():
+                        self._parked = True
+                        self._cv.notify_all()
+                        self._cv.wait(0.05)
+                    self._parked = False
+                    if self._stop or self._expired():
+                        self._stop = True
+                        self._cv.notify_all()
+                        return
+                    if not self._queue:
+                        continue
+                    key = self._queue.pop(0)
+                    projection, filters = self._work.pop(key)
+                    self._running = key
+                try:
+                    with tracing.span("storage.prefetch", partition=key[1]):
+                        tbl = key[0].read_partition(
+                            key[1], projection=projection, filters=filters)
+                except Exception:
+                    tbl = None  # miss marker: consumer re-reads, error
+                    #             surfaces typed on the query thread
+                with self._cv:
+                    self._running = None
+                    if self._stop:
+                        return
+                    self._ready[key] = tbl
+                    if tbl is not None:
+                        self._buffered += tbl.nbytes
+                        tracing.gauge("storage.prefetch_buffered_bytes",
+                                      self._buffered)
+                    self._cv.notify_all()
+
+
+# lock discipline (igloo-lint lock-discipline): every mutable field of the
+# pipeline is guarded by the one condition variable
+_GUARDED_BY = {"_cv": ("_queue", "_work", "_ready", "_running",
+                       "_buffered", "_parked", "_stop")}
+
+
+@contextlib.contextmanager
+def scan_prefetch(items, budget: Optional[int] = None, cancel=None,
+                  deadline: Optional[float] = None):
+    """Install a prefetcher over `items` — an iterable of (provider,
+    partition_index, projection, filters) in consumption order — for the
+    enclosed execution on THIS thread. No-op (yields None) when the kill
+    switch is off or there is nothing to prefetch; always torn down on
+    exit, so a cancelled/failed query cannot leak the reader thread or the
+    bytes budget."""
+    items = list(items)
+    if not items or not enabled():
+        yield None
+        return
+    pf = ScanPrefetcher(budget=budget, cancel=cancel, deadline=deadline)
+    for provider, index, projection, filters in items:
+        pf.enqueue(provider, index, projection, filters)
+    prev = getattr(_tls, "prefetcher", None)
+    _tls.prefetcher = pf.start()
+    try:
+        yield pf
+    finally:
+        _tls.prefetcher = prev
+        pf.close()

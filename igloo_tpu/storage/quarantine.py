@@ -1,0 +1,84 @@
+"""Corruption quarantine: known-bad objects are negative-cached, never
+re-read.
+
+A checksum/parse failure on an object (a parquet row group whose bytes no
+longer parse) is FATAL for that object: retrying re-reads the same bad
+bytes, and letting pyarrow's traceback surface raw tells the operator
+nothing actionable. The quarantine ladder instead:
+
+1. classifies the failure fatal-for-that-object (`record()` — counter
+   `storage.corrupt`, one WARNING log line naming file + row group),
+2. negative-caches the (key, row_group) pair so every later read of it
+   raises immediately without touching the store (`check()` — counter
+   `storage.quarantine_hit`),
+3. surfaces a typed `CorruptObjectError` naming table, file, and row group.
+
+Entries clear when the object's etag moves (a re-upload of the fixed file
+is a different version) — the registry keys on (key, etag, row_group).
+Bounded FIFO so a pathological source cannot grow the registry without
+limit. `clear()` resets (tests).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+
+from igloo_tpu.errors import CorruptObjectError
+from igloo_tpu.utils import tracing
+
+log = logging.getLogger("igloo_tpu.storage")
+
+MAX_ENTRIES = 1024
+
+# lock discipline (checked by igloo-lint lock-discipline):
+_GUARDED_BY = {"_lock": ("_bad",)}
+_lock = threading.Lock()
+_bad: OrderedDict = OrderedDict()   # (key, etag, row_group) -> reason
+
+
+def record(key: str, etag: str, row_group: int, reason: str,
+           table: str = "") -> CorruptObjectError:
+    """Quarantine one (object, row group) and return the typed error to
+    raise. Idempotent — re-recording an entry refreshes nothing."""
+    qk = (key, etag, int(row_group))
+    with _lock:
+        fresh = qk not in _bad
+        if fresh:
+            _bad[qk] = reason
+            while len(_bad) > MAX_ENTRIES:
+                _bad.popitem(last=False)
+    if fresh:
+        tracing.counter("storage.corrupt")
+        log.warning("storage: quarantined corrupt object %s row-group %d"
+                    "%s: %s", key, row_group,
+                    f" (table {table})" if table else "", reason)
+    return CorruptObjectError(
+        f"corrupt object{f' in table {table}' if table else ''}: "
+        f"{key} row-group {row_group}: {reason}",
+        key=key, row_group=int(row_group))
+
+
+def check(key: str, etag: str, row_group: int, table: str = "") -> None:
+    """Raise the quarantined error for (key, etag, row_group), if any —
+    the negative-cache fast path in front of every row-group read."""
+    qk = (key, etag, int(row_group))
+    with _lock:
+        reason = _bad.get(qk)
+    if reason is None:
+        return
+    tracing.counter("storage.quarantine_hit")
+    raise CorruptObjectError(
+        f"corrupt object{f' in table {table}' if table else ''} "
+        f"(quarantined): {key} row-group {row_group}: {reason}",
+        key=key, row_group=int(row_group))
+
+
+def size() -> int:
+    with _lock:
+        return len(_bad)
+
+
+def clear() -> None:
+    with _lock:
+        _bad.clear()

@@ -1,0 +1,93 @@
+"""Per-query snapshot pinning: one source version per query, verified reads.
+
+The engine opens a `pinned_scope()` around each query's execution
+(engine._run_select / EXPLAIN ANALYZE). Inside the scope the FIRST
+`provider.snapshot()` call per provider computes and CACHES its token and
+per-object etag map; every later snapshot() call in the same query returns
+the pinned copy instead of re-reading the live store. Ranged reads then
+verify the served object's etag against the pin on every read
+(store.ObjectFile), so a source mutated mid-query raises a typed
+`SnapshotChanged` instead of silently mixing two versions of the data into
+one result — the torn-result failure mode this layer exists to kill.
+
+Outside a scope (bare provider use, distributed workers executing one
+fragment) nothing is cached: snapshot() reads live and reads verify against
+the etag observed at open time, which still catches a mutation mid-file.
+
+Worker threads doing a query's reads (the storage prefetcher) join the
+query's pin scope via `capture()`/`adopt()`, the same idiom utils/stats.py
+uses for counters and spans.
+
+Pin entries key on `id(provider)` but hold the provider reference, so a
+freed provider can never alias a new one's id — and the whole map dies with
+the scope (one query), so entries cannot go stale across queries.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Optional
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def pinned_scope():
+    """Open a fresh pin map for the enclosed execution (re-entrant: an inner
+    scope shadows the outer one, so a nested engine call pins its own)."""
+    prev = getattr(_tls, "pins", None)
+    _tls.pins = {}
+    try:
+        yield
+    finally:
+        _tls.pins = prev
+
+
+def active() -> bool:
+    return getattr(_tls, "pins", None) is not None
+
+
+def pin(provider, compute: Callable[[], tuple]) -> tuple:
+    """`compute()` -> (token, etag_map). Inside a pinned scope the first
+    call per provider caches the result for the rest of the query; outside,
+    every call computes live. Returns the (token, etag_map) in force."""
+    pins = getattr(_tls, "pins", None)
+    if pins is None:
+        return compute()
+    ent = pins.get(id(provider))
+    if ent is None or ent[0] is not provider:
+        # the entry holds the provider and hits validate with `is` above,
+        # so a freed provider's reused id can never serve a stale pin
+        ent = (provider, compute())
+        pins[id(provider)] = ent
+    return ent[1]
+
+
+def pinned_etags(provider) -> Optional[dict]:
+    """The query-pinned {object key -> etag} map for `provider`, or None
+    when no pin exists (outside a scope, or snapshot() not yet called)."""
+    pins = getattr(_tls, "pins", None)
+    if pins is None:
+        return None
+    ent = pins.get(id(provider))
+    if ent is None or ent[0] is not provider:
+        return None
+    return ent[1][1]
+
+
+def capture() -> Optional[dict]:
+    """Snapshot of the current thread's pin map, for handing to a worker
+    thread (the storage prefetcher) doing this query's reads."""
+    return getattr(_tls, "pins", None)
+
+
+@contextlib.contextmanager
+def adopt(pins: Optional[dict]):
+    """Run a worker-thread block under a parent thread's pin map (shared by
+    reference: pins the parent adds mid-query are visible here too)."""
+    prev = getattr(_tls, "pins", None)
+    _tls.pins = pins
+    try:
+        yield
+    finally:
+        _tls.pins = prev

@@ -1,0 +1,424 @@
+"""ObjectStore: the engine's one way to touch source bytes.
+
+S3/GCS-shaped surface — `get_range(key, off, length)`, `head(key)` ->
+`(etag, size)`, `list_prefix(prefix)`, `put(key, data)` — with two
+backends: `LocalStore` (the local filesystem; etag derived from
+mtime_ns+size, list understands directories and globs exactly like the
+connectors always did) and `MemoryStore` (an in-memory S3-style bucket for
+tests: versioned etags, `damage()` for silent bitrot). "Towards an
+Arrow-native Storage System" (PAPERS.md) makes ranged object reads with
+snapshot tokens the scan foundation; this is that layer.
+
+EVERY operation runs under a `StoragePolicy` (storage/policy.py): fault
+injection first (`storage.get_range` / `storage.head` / `storage.list` /
+`storage.put` points in the IGLOO_FAULTS grammar, including the `corrupt`
+byte-flipping mode on get_range payloads), then transient-vs-fatal
+classification, bounded retry with backoff, and a typed `StorageError`
+(never a raw backend traceback) when the budget is spent. When a fault
+injector is active, each attempt additionally runs under the policy's
+read timeout on a watchdog thread so an injected HANG costs one bounded
+timeout — on a quiet process the timing thread is skipped entirely
+(local reads cannot be interrupted anyway; remote backends enforce their
+own deadlines).
+
+`open_input(key)` returns an `ObjectFile`: a file-like object (pyarrow
+wraps it in a PythonFile) whose every read is a policy-governed ranged GET
+*and* an etag re-verification against the version pinned at open (or the
+per-query pin from storage/snapshot.py) — a source mutated mid-query
+surfaces as `SnapshotChanged`, never as torn bytes.
+"""
+from __future__ import annotations
+
+import fnmatch as _fnmatch
+import glob as _glob
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from igloo_tpu.cluster import faults
+from igloo_tpu.errors import SnapshotChanged, StorageError
+from igloo_tpu.storage import policy as _policy
+from igloo_tpu.utils import tracing
+
+
+@dataclass(frozen=True)
+class ObjectMeta:
+    """head() result: the object's version token and size."""
+    key: str
+    etag: str
+    size: int
+
+
+def _timed(fn, timeout_s: Optional[float]):
+    """Run one attempt under a bound. Only pays the watchdog thread when a
+    fault injector is active (module docstring); an expired bound raises
+    TimeoutError — transient, so the policy loop retries it."""
+    if timeout_s is None or timeout_s <= 0 or not faults.active():
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["v"] = fn()
+        except BaseException as ex:  # hand ANY failure back to the caller
+            box["e"] = ex
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True, name="igloo-storage-io")
+    t.start()
+    if not done.wait(timeout_s):
+        raise TimeoutError(f"storage attempt exceeded {timeout_s}s")
+    if "e" in box:
+        raise box["e"]
+    return box["v"]
+
+
+class ObjectStore:
+    """Backend-agnostic base: subclasses implement the raw `_get_range` /
+    `_head` / `_list` / `_put` primitives; this class owns the policy loop,
+    fault injection, and telemetry. One instance may serve many providers
+    and threads — subclasses must keep the primitives thread-safe."""
+
+    #: scheme tag for diagnostics ("file", "mem", ...)
+    scheme = "object"
+
+    def __init__(self, policy: Optional[_policy.StoragePolicy] = None):
+        self.policy = policy
+
+    # --- primitives (subclass surface) ---------------------------------
+
+    def _get_range(self, key: str, off: int, length: int) -> bytes:
+        raise NotImplementedError
+
+    def _head(self, key: str) -> ObjectMeta:
+        raise NotImplementedError
+
+    def _list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def _put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    # --- the policy loop ------------------------------------------------
+
+    def _policy(self) -> _policy.StoragePolicy:
+        return self.policy or _policy.default_policy()
+
+    def _run(self, what: str, key: str, fn, timeout_s: Optional[float]):
+        """Inject -> attempt (bounded) -> classify -> retry with backoff.
+        Fatal or budget-spent failures surface as a typed StorageError
+        (FileNotFoundError passes through raw — callers map a vanished
+        object to SnapshotChanged, which needs the original type)."""
+        pol = self._policy()
+        attempt = 0
+
+        def one_attempt():
+            faults.inject(f"storage.{what}")
+            return fn()
+
+        while True:
+            try:
+                return _timed(one_attempt, timeout_s)
+            except Exception as ex:
+                if isinstance(ex, (StorageError, FileNotFoundError)):
+                    raise
+                if attempt >= pol.retries or not _policy.transient(ex):
+                    raise StorageError(
+                        f"storage {what} failed for {self.scheme}:{key} "
+                        f"after {attempt + 1} attempt"
+                        f"{'s' if attempt else ''}: {ex}") from ex
+                attempt += 1
+                tracing.counter("storage.retry")
+                time.sleep(pol.backoff_s(attempt))
+
+    # --- public surface -------------------------------------------------
+
+    def get_range(self, key: str, off: int, length: int) -> bytes:
+        tracing.counter("storage.read")
+        data = self._run("get_range", key,
+                         lambda: self._get_range(key, off, length),
+                         self._policy().read_timeout_s)
+        data = faults.corrupt_data("storage.get_range", data)
+        tracing.counter("storage.read_bytes", len(data))
+        return data
+
+    def head(self, key: str) -> ObjectMeta:
+        return self._run("head", key, lambda: self._head(key),
+                         self._policy().connect_timeout_s)
+
+    def list_prefix(self, prefix: str) -> list[str]:
+        """Keys under `prefix`: a directory-like prefix lists recursively,
+        a glob pattern matches, a plain existing key lists itself."""
+        return self._run("list", prefix, lambda: self._list(prefix),
+                         self._policy().connect_timeout_s)
+
+    def put(self, key: str, data: bytes) -> None:
+        self._run("put", key, lambda: self._put(key, data),
+                  self._policy().read_timeout_s)
+
+    def open_input(self, key: str, want_etag: Optional[str] = None,
+                   table: str = "") -> "ObjectFile":
+        """Open `key` for verified ranged reads. `want_etag` pins the
+        version the caller planned against (storage/snapshot.py); a
+        mismatch — at open or on any later read — raises SnapshotChanged.
+        A missing object raises SnapshotChanged too when a pin exists (the
+        planned-against object is gone: that IS a snapshot change);
+        without a pin the raw FileNotFoundError propagates."""
+        try:
+            meta = self.head(key)
+        except FileNotFoundError:
+            if want_etag is not None:
+                raise SnapshotChanged(
+                    f"object vanished since snapshot: {self.scheme}:{key}"
+                    f"{f' (table {table})' if table else ''}",
+                    table=table, key=key) from None
+            raise
+        if want_etag is not None and meta.etag != want_etag:
+            raise SnapshotChanged(
+                f"object changed since snapshot: {self.scheme}:{key} "
+                f"etag {meta.etag} != pinned {want_etag}"
+                f"{f' (table {table})' if table else ''}",
+                table=table, key=key)
+        return ObjectFile(self, key, meta, table=table)
+
+    def files_bytes(self, keys: list[str]) -> Optional[int]:
+        """Total size of `keys` (None when any is unreadable) — the
+        provider `estimated_bytes` helper. Policy-governed like every
+        other operation (best-effort only in its RESULT contract)."""
+        try:
+            return sum(self.head(k).size for k in keys)
+        except Exception:
+            return None
+
+    def snapshot_token(self, keys: list[str]) -> tuple[tuple, dict]:
+        """(token, etag_map) over `keys` — the cache/CDC invalidation token
+        AND the per-object pin map for verified reads. Heads run under the
+        policy (a transient blip is retried, not stamped into the pin —
+        stamping would burn the query's one snapshot re-plan on a healthy
+        source); only a genuinely VANISHED key stamps 'missing' (still a
+        token CHANGE vs. when it existed). A head that stays failed past
+        the retry budget propagates typed."""
+        tok = []
+        etags = {}
+        for k in keys:
+            try:
+                m = self.head(k)
+                tok.append((k, m.etag, m.size))
+                etags[k] = m.etag
+            except FileNotFoundError:
+                tok.append((k, "missing", -1))
+                etags[k] = "missing"
+        return tuple(tok), etags
+
+
+class ObjectFile:
+    """File-like ranged reader over one pinned object version (module
+    docstring). pyarrow's readers accept it directly (ParquetFile / CSV
+    open_input) — every `read()` re-verifies the etag, so a mutation lands
+    as SnapshotChanged at the very read that would have served torn bytes."""
+
+    mode = "rb"
+
+    def __init__(self, store: ObjectStore, key: str, meta: ObjectMeta,
+                 table: str = ""):
+        self._store = store
+        self.key = key
+        self.etag = meta.etag
+        self._size = meta.size
+        self._table = table
+        self._pos = 0
+        self.closed = False
+
+    # pyarrow PythonFile surface ----------------------------------------
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return False
+
+    def size(self) -> int:
+        return self._size
+
+    def tell(self) -> int:
+        return self._pos
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        elif whence == 2:
+            self._pos = self._size + offset
+        else:
+            raise ValueError(f"bad whence {whence}")
+        return self._pos
+
+    def read(self, nbytes: int = -1) -> bytes:
+        if nbytes is None or nbytes < 0:
+            nbytes = max(self._size - self._pos, 0)
+        if nbytes == 0:
+            return b""
+        try:
+            data = self._store.get_range(self.key, self._pos, nbytes)
+        except FileNotFoundError:
+            self._verify()   # vanished mid-read -> typed SnapshotChanged
+            raise            # unreachable unless it reappeared same-etag
+        # verify AFTER the read: a mutation landing between a pre-read
+        # check and the GET would serve new-version bytes under the old
+        # pin — checking the etag the served bytes must belong to closes
+        # that window (backends replace objects atomically: the read saw
+        # old or new, and 'new' fails this check)
+        self._verify()
+        self._pos += len(data)
+        return data
+
+    def close(self) -> None:
+        self.closed = True
+
+    def __enter__(self) -> "ObjectFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _verify(self) -> None:
+        try:
+            meta = self._store.head(self.key)
+        except FileNotFoundError:
+            raise SnapshotChanged(
+                f"object vanished mid-read: {self._store.scheme}:{self.key}"
+                f"{f' (table {self._table})' if self._table else ''}",
+                table=self._table, key=self.key) from None
+        if meta.etag != self.etag:
+            raise SnapshotChanged(
+                f"object changed mid-read: {self._store.scheme}:{self.key} "
+                f"etag {meta.etag} != pinned {self.etag}"
+                f"{f' (table {self._table})' if self._table else ''}",
+                table=self._table, key=self.key)
+
+
+class LocalStore(ObjectStore):
+    """Local-filesystem backend. Keys are paths; etag = mtime_ns + size in
+    hex (the same signal file_snapshot always used, folded into one
+    string). Stateless — one shared instance serves every connector."""
+
+    scheme = "file"
+
+    def _get_range(self, key: str, off: int, length: int) -> bytes:
+        with open(key, "rb") as fh:
+            fh.seek(off)
+            return fh.read(length)
+
+    def _head(self, key: str) -> ObjectMeta:
+        st = os.stat(key)
+        return ObjectMeta(key, f"{st.st_mtime_ns:x}-{st.st_size:x}",
+                          st.st_size)
+
+    def _list(self, prefix: str) -> list[str]:
+        if os.path.isdir(prefix):
+            return sorted(
+                p for p in _glob.glob(os.path.join(prefix, "**", "*"),
+                                      recursive=True) if os.path.isfile(p))
+        if any(ch in prefix for ch in "*?["):
+            return sorted(p for p in _glob.glob(prefix) if os.path.isfile(p))
+        return [prefix] if os.path.exists(prefix) else []
+
+    def _put(self, key: str, data: bytes) -> None:
+        d = os.path.dirname(key)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{key}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, key)   # atomic: readers see old or new, never torn
+
+
+class MemoryStore(ObjectStore):
+    """In-memory S3-style bucket (tests, fault-injection smokes). Objects
+    carry a monotonically versioned etag: `put` bumps it (a visible commit),
+    `damage` flips bytes WITHOUT bumping it (silent bitrot — only the
+    corruption quarantine can catch that). Thread-safe."""
+
+    scheme = "mem"
+
+    def __init__(self, policy: Optional[_policy.StoragePolicy] = None):
+        super().__init__(policy)
+        self._objects: dict[str, list] = {}
+        self._mem_lock = threading.Lock()
+
+    def _entry(self, key: str) -> list:
+        """caller-locked or read-only snapshot: returns the live entry."""
+        ent = self._objects.get(key)
+        if ent is None:
+            raise FileNotFoundError(f"mem:{key}")
+        return ent
+
+    def _get_range(self, key: str, off: int, length: int) -> bytes:
+        with self._mem_lock:
+            data = self._entry(key)[0]
+        return data[off:off + length]
+
+    def _head(self, key: str) -> ObjectMeta:
+        with self._mem_lock:
+            data, version = self._entry(key)
+        return ObjectMeta(key, f"v{version}", len(data))
+
+    def _list(self, prefix: str) -> list[str]:
+        with self._mem_lock:
+            keys = list(self._objects)
+        if any(ch in prefix for ch in "*?["):
+            return sorted(k for k in keys
+                          if _fnmatch.fnmatchcase(k, prefix))
+        if prefix in keys:
+            return [prefix]
+        p = prefix.rstrip("/") + "/"
+        return sorted(k for k in keys if k.startswith(p))
+
+    def _put(self, key: str, data: bytes) -> None:
+        with self._mem_lock:
+            ent = self._objects.get(key)
+            if ent is None:
+                self._objects[key] = [bytes(data), 1]
+            else:
+                ent[0] = bytes(data)
+                ent[1] += 1
+
+    def delete(self, key: str) -> None:
+        with self._mem_lock:
+            self._objects.pop(key, None)
+
+    def damage(self, key: str, at: Optional[int] = None,
+               nbytes: int = 64) -> None:
+        """Flip a byte run in place WITHOUT changing the etag: silent
+        bitrot, only detectable by parse/checksum failure (the quarantine
+        path's test hook)."""
+        with self._mem_lock:
+            ent = self._entry(key)
+            buf = bytearray(ent[0])
+            start = len(buf) // 2 if at is None else at
+            for i in range(start, min(start + nbytes, len(buf))):
+                buf[i] ^= 0xFF
+            ent[0] = bytes(buf)
+
+
+# the module-wide _GUARDED_BY (igloo-lint lock-discipline): MemoryStore's
+# bucket map is hit from reader threads and the prefetcher concurrently
+_GUARDED_BY = {"_mem_lock": ("_objects",)}
+
+
+_local: Optional[LocalStore] = None
+
+
+def local_store() -> LocalStore:
+    """The shared LocalStore instance (policy = process default)."""
+    global _local
+    if _local is None:
+        _local = LocalStore()
+    return _local

@@ -32,6 +32,9 @@ python scripts/twolevel_smoke.py
 echo "== chaos smoke (injected faults + worker kill + hung worker) =="
 python scripts/chaos_smoke.py
 
+echo "== storage smoke (fault-injected object store: retries + snapshot re-plan + bounded prefetch) =="
+python scripts/storage_smoke.py
+
 echo "== persistent compile-cache smoke (two-process cold/warm) =="
 python scripts/compile_cache_smoke.py
 

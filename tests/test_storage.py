@@ -1,0 +1,461 @@
+"""The resilient object-store storage layer (docs/storage.md):
+
+- ranged reads through ObjectFile are byte- and table-identical to
+  whole-file reads, on both backends;
+- the StoragePolicy absorbs transient faults within its retry budget,
+  exhausts typed, and never retries fatal classes;
+- a source mutated mid-query raises SnapshotChanged and the engine
+  re-plans exactly ONCE, returning the post-mutation result (never torn);
+- corrupt row groups quarantine behind a typed error naming file + row
+  group, and the negative cache answers repeats without re-reading;
+- the async prefetcher overlaps reads with consumption, honors its bytes
+  budget, tears down on cancellation, and IGLOO_STORAGE_PREFETCH=0 is
+  bit-identical;
+- cdc.SourceWatcher survives (and counts) raising callbacks.
+"""
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from igloo_tpu.catalog import MemTable
+from igloo_tpu.cluster import faults
+from igloo_tpu.connectors.parquet import ParquetTable
+from igloo_tpu.engine import QueryEngine
+from igloo_tpu.errors import (
+    CorruptObjectError, SnapshotChanged, StorageError,
+)
+from igloo_tpu.storage import (
+    LocalStore, MemoryStore, StoragePolicy, quarantine, transient,
+)
+from igloo_tpu.storage import prefetch as sprefetch
+from igloo_tpu.storage import snapshot as ssnap
+from igloo_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_storage_state():
+    faults.clear()
+    quarantine.clear()
+    yield
+    faults.clear()
+    quarantine.clear()
+
+
+FAST = StoragePolicy(retries=3, backoff_base_s=0.001, backoff_max_s=0.002)
+
+
+def _parquet_bytes(t: pa.Table, row_group_size=50) -> bytes:
+    sink = pa.BufferOutputStream()
+    pq.write_table(t, sink, row_group_size=row_group_size)
+    return sink.getvalue().to_pybytes()
+
+
+def _table(n=200, seed=3) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": rng.integers(0, 7, n),
+                     "v": rng.random(n),
+                     "q": rng.integers(1, 100, n).astype(np.int64)})
+
+
+# --- backends + ranged reads -------------------------------------------------
+
+
+def test_ranged_reads_match_whole_file(tmp_path):
+    data = bytes(range(256)) * 100
+    p = tmp_path / "blob.bin"
+    p.write_bytes(data)
+    store = LocalStore(policy=FAST)
+    meta = store.head(str(p))
+    assert meta.size == len(data)
+    # stitched ranged reads == the file
+    got = b"".join(store.get_range(str(p), off, 999)
+                   for off in range(0, len(data), 999))
+    assert got == data
+    # ObjectFile through pyarrow: parquet table round-trips identically
+    t = _table()
+    pqp = tmp_path / "t.parquet"
+    pq.write_table(t, pqp, row_group_size=50)
+    via_store = pq.ParquetFile(store.open_input(str(pqp))).read()
+    assert via_store.equals(pq.read_table(pqp))
+
+
+def test_memory_store_backend():
+    mem = MemoryStore(policy=FAST)
+    t = _table()
+    mem.put("bucket/data/t.parquet", _parquet_bytes(t))
+    assert mem.list_prefix("bucket/data") == ["bucket/data/t.parquet"]
+    m1 = mem.head("bucket/data/t.parquet")
+    mem.put("bucket/data/t.parquet", _parquet_bytes(t))
+    assert mem.head("bucket/data/t.parquet").etag != m1.etag  # commit bumps
+    with pytest.raises(FileNotFoundError):
+        mem.head("bucket/missing")
+    # a ParquetTable scans the in-memory bucket like any directory
+    pt = ParquetTable("bucket/data", store=mem)
+    assert pt.read().equals(t)
+    assert pt.num_partitions() == 4  # 200 rows / 50 per group
+
+
+def test_provider_roundtrip_local_vs_memory(tmp_path):
+    t = _table()
+    p = tmp_path / "t.parquet"
+    pq.write_table(t, p, row_group_size=50)
+    mem = MemoryStore(policy=FAST)
+    mem.put("t.parquet", _parquet_bytes(t))
+    a = ParquetTable(str(p), store=LocalStore(policy=FAST))
+    b = ParquetTable("t.parquet", store=mem)
+    assert a.read().equals(b.read())
+    for i in range(a.num_partitions()):
+        assert a.read_partition(i).equals(b.read_partition(i))
+
+
+# --- policy: retry / exhaustion / classification -----------------------------
+
+
+def test_transient_faults_absorbed_within_budget():
+    mem = MemoryStore(policy=FAST)
+    mem.put("k", b"x" * 1000)
+    faults.install("storage.get_range:error:1.0:2", seed=1)  # 2 then healthy
+    r0 = tracing.counters().get("storage.retry", 0)
+    assert mem.get_range("k", 0, 1000) == b"x" * 1000
+    assert tracing.counters().get("storage.retry", 0) - r0 == 2
+
+
+def test_retry_budget_exhaustion_is_typed():
+    mem = MemoryStore(policy=FAST)
+    mem.put("k", b"x")
+    faults.install("storage.get_range:error:1.0", seed=1)  # never heals
+    with pytest.raises(StorageError, match="after 4 attempts"):
+        mem.get_range("k", 0, 1)
+
+
+def test_fatal_classes_never_retry():
+    mem = MemoryStore(policy=FAST)
+    r0 = tracing.counters().get("storage.retry", 0)
+    with pytest.raises(FileNotFoundError):
+        mem.head("nope")
+    assert tracing.counters().get("storage.retry", 0) == r0
+    assert not transient(FileNotFoundError())
+    assert not transient(SnapshotChanged("x"))
+    assert not transient(CorruptObjectError("x"))
+    assert transient(TimeoutError())
+    assert transient(ConnectionResetError())
+
+
+def test_injected_hang_is_rescued_by_read_timeout():
+    mem = MemoryStore(policy=FAST.with_(read_timeout_s=0.2, retries=1))
+    mem.put("k", b"y" * 10)
+    faults.install("storage.get_range:hang:1.0:1", seed=1, hang_s=30.0)
+    t0 = time.perf_counter()
+    assert mem.get_range("k", 0, 10) == b"y" * 10  # retry after the timeout
+    assert time.perf_counter() - t0 < 5.0
+
+
+def test_backoff_shape():
+    p = StoragePolicy(backoff_base_s=0.1, backoff_max_s=0.3,
+                      backoff_jitter=0.0)
+    assert p.backoff_s(1) == pytest.approx(0.1)
+    assert p.backoff_s(2) == pytest.approx(0.2)
+    assert p.backoff_s(5) == pytest.approx(0.3)  # capped
+
+
+# --- snapshot pinning: mid-query mutation -> ONE re-plan ---------------------
+
+
+class MutatingParquet(ParquetTable):
+    """Rewrites its file with `next_table` the first time the engine reads
+    it — AFTER the query pinned its snapshot — simulating a writer landing
+    mid-query."""
+
+    def __init__(self, path, next_table):
+        super().__init__(path)
+        self._next = next_table
+        self.mutations = 0
+
+    def read(self, projection=None, filters=None):
+        if self.mutations == 0:
+            self.mutations += 1
+            time.sleep(0.01)   # distinct mtime_ns on coarse clocks
+            pq.write_table(self._next, self.path)
+        return super().read(projection=projection, filters=filters)
+
+
+def test_mid_query_mutation_replans_once(tmp_path):
+    t_old = pa.table({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+    t_new = pa.table({"k": [5, 5, 5, 6], "v": [10.0, 10.0, 10.0, 2.0]})
+    p = str(tmp_path / "m.parquet")
+    pq.write_table(t_old, p)
+    eng = QueryEngine(use_jit=False)
+    prov = MutatingParquet(p, t_new)
+    eng.register_table("m", prov)
+    with tracing.counter_delta() as delta:
+        out = eng.execute("SELECT k, SUM(v) AS sv FROM m GROUP BY k "
+                          "ORDER BY k")
+    # exactly one bounded re-plan, and the result is the NEW snapshot's —
+    # never a torn mix of the two versions
+    assert delta.get("storage.snapshot_retry") == 1
+    assert prov.mutations == 1
+    assert out.to_pydict() == {"k": [5, 6], "sv": [30.0, 2.0]}
+
+
+def test_vanished_file_is_snapshot_change_not_crash(tmp_path):
+    t = _table(100)
+    d = tmp_path / "dir"
+    d.mkdir()
+    pq.write_table(t.slice(0, 50), d / "a.parquet")
+    pq.write_table(t.slice(50, 50), d / "b.parquet")
+    pt = ParquetTable(str(d))
+    assert pt.num_partitions() == 2
+    (d / "b.parquet").unlink()
+    with ssnap.pinned_scope():
+        pt.snapshot()
+        with pytest.raises(SnapshotChanged):
+            pt.read_partition(1)
+    # _partition_index tolerates the vanished file (satellite): rebuilt
+    # index drops it instead of raising
+    pt2 = ParquetTable(str(d / "*.parquet"))
+    assert pt2.num_partitions() == 1
+
+
+def test_pinned_scope_freezes_snapshot(tmp_path):
+    t = _table(60)
+    p = str(tmp_path / "s.parquet")
+    pq.write_table(t, p)
+    pt = ParquetTable(p)
+    with ssnap.pinned_scope():
+        tok1 = pt.snapshot()
+        time.sleep(0.01)
+        pq.write_table(_table(60, seed=9), p)
+        assert pt.snapshot() == tok1      # pinned: same token mid-query
+    assert pt.snapshot() != tok1          # next query sees the new version
+
+
+# --- corruption quarantine ---------------------------------------------------
+
+
+def test_corrupt_row_group_quarantined():
+    mem = MemoryStore(policy=FAST)
+    t = _table(200)
+    mem.put("c.parquet", _parquet_bytes(t, row_group_size=50))
+    pt = ParquetTable("c.parquet", store=mem)
+    assert pt.read_partition(1).num_rows == 50
+    mem.damage("c.parquet")   # silent bitrot: same etag, bad bytes
+    with tracing.counter_delta() as delta:
+        with pytest.raises(CorruptObjectError) as ei:
+            for i in range(pt.num_partitions()):
+                pt.read_partition(i)
+    # the typed error names file + row group; counted once
+    assert "c.parquet" in str(ei.value) and "row-group" in str(ei.value)
+    assert ei.value.row_group >= 0
+    assert delta.get("storage.corrupt") == 1
+    # negative cache: the SAME (file, etag, row group) errors without a read
+    reads0 = tracing.counters().get("storage.read", 0)
+    with pytest.raises(CorruptObjectError):
+        pt.read_partition(ei.value.row_group)
+    assert tracing.counters().get("storage.quarantine_hit", 0) >= 1
+    assert tracing.counters().get("storage.read", 0) == reads0
+    # a re-upload (new etag) clears the quarantine by construction
+    mem.put("c.parquet", _parquet_bytes(t, row_group_size=50))
+    assert pt.read_partition(ei.value.row_group).num_rows == 50
+
+
+def test_injected_corrupt_mode():
+    mem = MemoryStore(policy=FAST)
+    mem.put("x.parquet", _parquet_bytes(_table(100), row_group_size=100))
+    pt = ParquetTable("x.parquet", store=mem)
+    faults.install("storage.get_range:corrupt:1.0", seed=2)
+    with pytest.raises(CorruptObjectError):
+        pt.read_partition(0)
+    faults.clear()
+    quarantine.clear()
+    assert pt.read_partition(0).num_rows == 100
+
+
+# --- prefetcher --------------------------------------------------------------
+
+
+class SlowProvider:
+    """Counts reads; sleeps to make overlap measurable."""
+
+    def __init__(self, tables, delay=0.02):
+        self.tables = tables
+        self.delay = delay
+        self.reads = []
+
+    def read_partition(self, index, projection=None, filters=None):
+        time.sleep(self.delay)
+        self.reads.append(index)
+        return self.tables[index]
+
+
+def test_prefetch_overlap_and_hits():
+    parts = [_table(100, seed=i) for i in range(6)]
+    prov = SlowProvider(parts)
+    items = [(prov, i, None, None) for i in range(6)]
+    with tracing.counter_delta() as delta:
+        with sprefetch.scan_prefetch(items) as pf:
+            assert pf is not None
+            got = []
+            for i in range(6):
+                t = pf.take(prov, i, None)
+                assert t is not None and t.equals(parts[i])
+                got.append(t)
+                time.sleep(0.02)   # "compute": the reader runs ahead
+    assert delta.get("storage.prefetch_hit") == 6
+    assert prov.reads == list(range(6))   # consumption order preserved
+
+
+def test_prefetch_bytes_budget():
+    parts = [_table(400, seed=i) for i in range(8)]
+    one = parts[0].nbytes
+    prov = SlowProvider(parts, delay=0.0)
+    pf = sprefetch.ScanPrefetcher(budget=one * 2)
+    for i in range(8):
+        pf.enqueue(prov, i, None, None)
+    pf.start()
+    time.sleep(0.3)   # reader must park at the bound, not slurp all 8
+    with pf._cv:
+        assert pf._buffered <= one * 3   # budget + at most one in-flight
+        assert len(pf._ready) < 8
+    # draining proceeds: ready keys hand over, keys caught behind the
+    # parked reader are stolen back as misses — the consumer's sync
+    # fallback (exactly what read_scan_table does) covers those
+    hits = 0
+    for i in range(8):
+        t = pf.take(prov, i, None)
+        if t is None:
+            t = prov.read_partition(i)
+        else:
+            hits += 1
+        assert t.equals(parts[i])
+    assert hits >= 1
+    pf.close()
+
+
+def test_prefetch_parked_reader_never_deadlocks_consumer():
+    parts = [_table(400, seed=i) for i in range(6)]
+    one = parts[0].nbytes
+    prov = SlowProvider(parts, delay=0.0)
+    pf = sprefetch.ScanPrefetcher(budget=one)   # parks after ~2 tables
+    for i in range(6):
+        pf.enqueue(prov, i, None, None)
+    pf.start()
+    time.sleep(0.3)   # reader fills the budget and parks
+    # nobody drains the early tables (a warm cache-served scan wouldn't);
+    # taking a still-queued TAIL key must steal it back as a miss
+    # promptly, never wait on the parked reader
+    t0 = time.perf_counter()
+    assert pf.take(prov, 5, None) is None
+    assert time.perf_counter() - t0 < 2.0
+    pf.close()
+
+
+def test_prefetch_cancellation_teardown():
+    class Tok:
+        cancelled = False
+    tok = Tok()
+    parts = [_table(50, seed=i) for i in range(20)]
+    prov = SlowProvider(parts, delay=0.05)
+    pf = sprefetch.ScanPrefetcher(cancel=tok)
+    for i in range(20):
+        pf.enqueue(prov, i, None, None)
+    pf.start()
+    time.sleep(0.12)
+    tok.cancelled = True
+    pf._thread.join(timeout=5.0)
+    assert not pf._thread.is_alive()      # reader stopped at a boundary
+    assert len(prov.reads) < 20           # ... well before the queue drained
+    assert pf.take(prov, 19, None) is None  # post-cancel takes are misses
+    pf.close()
+
+
+def test_prefetch_failure_is_a_miss():
+    class Flaky(SlowProvider):
+        def read_partition(self, index, projection=None, filters=None):
+            if index == 1:
+                raise StorageError("boom")
+            return super().read_partition(index, projection, filters)
+
+    parts = [_table(30, seed=i) for i in range(3)]
+    prov = Flaky(parts, delay=0.0)
+    items = [(prov, i, None, None) for i in range(3)]
+    with sprefetch.scan_prefetch(items) as pf:
+        assert pf.take(prov, 0, None) is not None
+        assert pf.take(prov, 1, None) is None   # consumer re-reads sync
+        assert pf.take(prov, 2, None) is not None
+
+
+def test_chunked_query_prefetches_and_kill_switch_is_identical(
+        tmp_path, monkeypatch):
+    rng = np.random.default_rng(5)
+    n = 20000
+    t = pa.table({"k": rng.integers(0, 25, n),
+                  "v": rng.random(n),
+                  "q": rng.integers(1, 100, n).astype(np.int64)})
+    p = str(tmp_path / "big.parquet")
+    pq.write_table(t, p, row_group_size=2000)  # 10 row groups
+    sql = ("SELECT k, SUM(v) AS sv, COUNT(*) AS c FROM t GROUP BY k "
+           "ORDER BY k")
+
+    def run():
+        eng = QueryEngine(use_jit=False, chunk_budget_bytes=1 << 18)
+        eng.register_table("t", ParquetTable(p))
+        with tracing.counter_delta() as delta:
+            out = eng.query(sql)
+        assert delta.get("engine.chunked_route") == 1
+        return out.table, delta
+
+    out_on, d_on = run()
+    assert d_on.get("storage.prefetch_hit") > 0
+    monkeypatch.setenv("IGLOO_STORAGE_PREFETCH", "0")
+    out_off, d_off = run()
+    assert d_off.get("storage.prefetch_hit") == 0
+    assert out_on.equals(out_off)         # kill switch: bit-identical
+
+
+# --- cdc satellite -----------------------------------------------------------
+
+
+def test_cdc_callback_errors_counted_not_fatal(tmp_path):
+    from igloo_tpu.cdc import SourceWatcher
+    t = _table(40)
+    p = str(tmp_path / "w.parquet")
+    pq.write_table(t, p)
+    eng = QueryEngine(use_jit=False)
+    eng.register_table("w", ParquetTable(p))
+    w = SourceWatcher(eng, interval_s=0.05)
+    seen = []
+    w.on_change(lambda name: (_ for _ in ()).throw(RuntimeError("bad cb")))
+    w.on_change(seen.append)
+    w.poll()                              # baseline tokens
+    time.sleep(0.01)
+    pq.write_table(_table(40, seed=8), p)
+    with tracing.counter_delta() as delta:
+        changed = w.poll()
+    assert changed == ["w"]
+    assert delta.get("cdc.callback_errors") == 1
+    assert seen == ["w"]                  # later callbacks still fired
+
+
+def test_cdc_on_change_is_lock_safe(tmp_path):
+    from igloo_tpu.cdc import SourceWatcher
+    eng = QueryEngine(use_jit=False)
+    eng.register_table("m", MemTable(_table(10)))
+    w = SourceWatcher(eng, interval_s=0.01)
+    stop = threading.Event()
+
+    def register_loop():
+        while not stop.is_set():
+            w.on_change(lambda name: None)
+
+    th = threading.Thread(target=register_loop)
+    th.start()
+    try:
+        for _ in range(50):
+            w.poll()
+    finally:
+        stop.set()
+        th.join()
