@@ -55,7 +55,7 @@ _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 _DELTA_PREFIXES = ("jit.", "pack.", "grace.", "chunked.", "xfer.",
                    "cache.", "result_cache.", "engine.", "fused.", "join.",
                    "exchange.", "compile_cache.", "adaptive.", "pallas.",
-                   "mesh.")
+                   "mesh.", "codec.")
 
 # Pallas kernel names whose dispatch counters feed the per-query `pallas`
 # block (docs/kernels.md); fallback/overflow counters are summed beside

@@ -1376,8 +1376,11 @@ class CoordinatorServer(flight.FlightServerBase):
             # from the executor's finalize instead (trace.deferred).
             flight_recorder.publish(trace)
         if isinstance(out, tuple):
-            # distributed: relay the root worker's stream batch-wise
-            return flight.GeneratorStream(
+            # distributed: relay the root worker's stream batch-wise, via
+            # rpc.flight_stream_response so dictionary-bearing result schemas
+            # get their dictionary batches written without costing plain
+            # schemas their Flight error statuses
+            return rpc.flight_stream_response(
                 out[0], faults.wrap_stream("coordinator.do_get", out[1]))
         return flight.RecordBatchStream(out)
 

@@ -263,14 +263,29 @@ class FragmentStore:
             partition: Optional[tuple[list[int], int]] = None,
             salt: Optional[tuple] = None) -> _Stored:
         if partition is not None:
+            from igloo_tpu.exec import encoded
             keys, nb = partition
             # store-time hash partition on the query timeline: per-bucket
-            # slices of THIS fragment's result, the exchange's shuffle write
+            # slices of THIS fragment's result, the exchange's shuffle write.
+            # Partitioned results ship ENCODED (exec/encoded.py): strings
+            # dictionary-encode ONCE on the whole input — the hash routes by
+            # dictionary VALUES, so placement is unchanged and every bucket
+            # slice shares one unified dictionary instead of rebuilding one
+            # per record batch — and numerics narrow per slice under ONE
+            # global spec, applied AFTER routing (hashing an offset carrier
+            # would misroute keys across the two sides of a join). The peer
+            # decodes on fetch (cluster/worker.py _fetch_dep); spilled
+            # entries write the carrier bytes to disk as-is.
             with tracing.span("exchange.partition", buckets=nb,
                               rows=table.num_rows, salted=salt is not None):
+                table = encoded.encode_strings(table)
+                plan = encoded.plan_numeric(table)
                 slices, base = salted_partition(table, list(keys), nb, salt)
                 batches, ranges, meta = [], [], []
+                schema = None
                 for s in slices:
+                    s = encoded.apply_numeric(s, plan)
+                    schema = s.schema if schema is None else schema
                     bs = _chunk(s)
                     ranges.append((len(batches), len(bs)))
                     batches.extend(bs)
@@ -278,11 +293,12 @@ class FragmentStore:
                                  "bytes": sum(b.nbytes for b in bs)})
             tracing.counter("exchange.partitions")
             tracing.counter("exchange.partition_rows", table.num_rows)
-            ent = _Stored(schema=table.schema, batches=batches,
+            ent = _Stored(schema=schema, batches=batches,
                           nbytes=sum(b.nbytes for b in batches),
                           nbuckets=len(slices), ranges=ranges, meta=meta,
                           rows=table.num_rows,
                           base_rows=[int(c) for c in base])
+            tracing.counter("exchange.partition_bytes", ent.nbytes)
         else:
             batches = _chunk(table)
             ent = _Stored(schema=table.schema, batches=batches,

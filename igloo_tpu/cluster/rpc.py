@@ -34,6 +34,7 @@ import weakref
 from dataclasses import dataclass
 from typing import Optional
 
+import pyarrow as pa
 import pyarrow.flight as flight
 
 from igloo_tpu.cluster import faults
@@ -377,6 +378,30 @@ def flight_actions_raw(addr: str, actions,
             yield results[0].body.to_pybytes() if results else b""
     finally:
         client.close()
+
+
+def flight_stream_response(schema, gen):
+    """Server-side half of a streaming do_get. Two stream shapes, because
+    pyarrow makes each wrong in a different way:
+
+    - GeneratorStream(schema, gen) preserves Flight error STATUSES raised
+      mid-generator (a FlightUnavailableError stays UNAVAILABLE on the wire,
+      which the client-side peer-loss classification depends on) — but its
+      IPC writer never emits dictionary batches, so any dictionary-bearing
+      schema dies at the peer's reader with "expected number (1) of
+      dictionaries at the start of the stream".
+    - A RecordBatchReader-backed RecordBatchStream writes dictionary batches
+      correctly and still pulls one batch at a time (spilled fragments
+      stream straight off their IPC spill files) — but a mid-generator
+      exception crosses the C++ reader boundary and degrades to a generic
+      FlightServerError.
+
+    So: reader-backed only when the schema actually carries dictionaries
+    (encoded exchange slices), GeneratorStream everywhere else."""
+    if any(pa.types.is_dictionary(f.type) for f in schema):
+        return flight.RecordBatchStream(
+            pa.RecordBatchReader.from_batches(schema, gen))
+    return flight.GeneratorStream(schema, gen)
 
 
 def flight_stream_batches(addr: str, ticket,

@@ -42,6 +42,7 @@ import pyarrow.flight as flight
 from igloo_tpu.catalog import Catalog, MemTable
 from igloo_tpu.cluster import exchange, faults, protocol, serde
 from igloo_tpu.cluster.fragment import FRAG_PREFIX, _frag_refs
+from igloo_tpu.exec import encoded
 from igloo_tpu.cluster import rpc
 from igloo_tpu.cluster.rpc import flight_action, flight_stream_batches
 from igloo_tpu.cluster.rpc import normalize as _normalize
@@ -202,7 +203,10 @@ class WorkerServer(flight.FlightServerBase):
         # zero-copy local read, not a transfer
         if frag_id in self._store:
             try:
-                return self._store.get_table(frag_id, bucket, nbuckets)
+                # partitioned slices are stored in carrier form
+                # (cluster/exchange.py put) — widen at the consumption edge
+                return encoded.decode_table(
+                    self._store.get_table(frag_id, bucket, nbuckets))
             except (KeyError, ValueError) as ex:
                 raise IglooError(f"DEP_UNAVAILABLE:{frag_id} local: {ex}")
         dep_key = _dep_key(frag_id, bucket)
@@ -227,7 +231,11 @@ class WorkerServer(flight.FlightServerBase):
                     nbytes += batch.nbytes
                     tracing.counter("exchange.fetch_rows", batch.num_rows)
                     tracing.counter("exchange.fetch_bytes", batch.nbytes)
-                table = pa.Table.from_batches(batches, schema=schema)
+                # fetch counters above price the WIRE (carrier) bytes; the
+                # dep cache below holds the decoded table so co-located
+                # dependents never re-widen
+                table = encoded.decode_table(
+                    pa.Table.from_batches(batches, schema=schema))
                 sp.attrs.update(rows=table.num_rows, bytes=nbytes)
         except Exception as ex:
             raise IglooError(f"DEP_UNAVAILABLE:{frag_id} peer {addr}: {ex}")
@@ -443,9 +451,11 @@ class WorkerServer(flight.FlightServerBase):
                 tracing.counter("exchange.rows", b.num_rows)
                 tracing.counter("exchange.bytes", b.nbytes)
                 yield b
-        # GeneratorStream: one in-flight batch, never the whole table — a
-        # spilled fragment streams straight off its IPC spill file
-        return flight.GeneratorStream(
+        # encoded partition slices carry dictionary fields, which
+        # GeneratorStream would silently drop — rpc.flight_stream_response
+        # picks the stream shape that keeps both dictionaries and Flight
+        # error statuses intact
+        return rpc.flight_stream_response(
             schema, faults.wrap_stream("worker.do_get", counted()))
 
 

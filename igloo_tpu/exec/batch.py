@@ -176,9 +176,19 @@ class DictInfo:
 
 @dataclass
 class DeviceColumn:
-    """One column: a padded device lane + optional null lane + host dictionary."""
+    """One column: a padded device lane + optional null lane + host dictionary.
+
+    When `carrier` is set, `values` holds the NARROW transfer carrier
+    (exec/codec.py) rather than the engine lane dtype — the compressed form is
+    the resident form. Operators that need actual values widen at the point of
+    use via `wide_values` (in-jit: XLA fuses the cast/divide into the
+    consumer, so the wide lane exists only transiently inside the program);
+    operators that only move/mask/gather rows (filters via masks, compaction,
+    resize, exchange staging) keep the carrier untouched. `carrier_arg` is the
+    0-d runtime payload (real offset / scale divisor) matching the CANONICAL
+    spec in `carrier` — see codec.upload_columns for why it is runtime data."""
     dtype: DataType
-    values: jax.Array              # [capacity], device dtype per DataType.device_dtype
+    values: jax.Array              # [capacity], carrier dtype when `carrier` is set
     nulls: Optional[jax.Array]     # [capacity] bool, True = null; None = no nulls
     dictionary: Optional[DictInfo] = None  # STRING columns only
     # host-side (lo, hi) value bounds for integer-family columns, computed at
@@ -186,6 +196,8 @@ class DeviceColumn:
     # Powers the direct "array join" fast path (exec/join.py direct_join):
     # dense PK-FK joins become scatter+gather instead of sorts. None = unknown.
     bounds: Optional[tuple] = None
+    carrier: Optional["WidenSpec"] = None   # codec.WidenSpec; None = wide lane
+    carrier_arg: Optional[jax.Array] = None  # 0-d offset/scale payload
 
     @property
     def capacity(self) -> int:
@@ -193,6 +205,37 @@ class DeviceColumn:
 
     def with_nulls(self, nulls: Optional[jax.Array]) -> "DeviceColumn":
         return replace(self, nulls=nulls)
+
+
+def wide_values(col: DeviceColumn) -> jax.Array:
+    """The column's engine-lane values, widening the resident carrier in-jit
+    if there is one. THE single decode point for device operators: call this
+    (inside a jitted program — Env.from_batch does) instead of reading
+    `.values` wherever actual values are consumed. Traced or eager."""
+    spec = col.carrier
+    if spec is None:
+        return col.values
+    if spec.scale != 1.0:
+        return spec.widen(col.values, scale_arg=col.carrier_arg)
+    if spec.offset:
+        return spec.widen(col.values, offset_arg=col.carrier_arg)
+    return spec.widen(col.values)
+
+
+def materialize(col: DeviceColumn) -> DeviceColumn:
+    """Eagerly widen a column to its engine lane (carrier dropped). Boundary
+    escape hatch for code paths that cannot carry the carrier metadata —
+    today: sharding a batch across the device mesh (parallel/mesh.py), where a
+    0-d carrier_arg cannot take a row-sharded PartitionSpec."""
+    if col.carrier is None:
+        return col
+    return replace(col, values=wide_values(col), carrier=None, carrier_arg=None)
+
+
+def materialize_batch(batch: "DeviceBatch") -> "DeviceBatch":
+    if all(c.carrier is None for c in batch.columns):
+        return batch
+    return replace(batch, columns=[materialize(c) for c in batch.columns])
 
 
 @dataclass
@@ -239,9 +282,16 @@ class DeviceBatch:
 
 jax.tree_util.register_pytree_node(
     DeviceColumn,
-    lambda c: ((c.values, c.nulls), (c.dtype, c.dictionary, c.bounds)),
+    # carrier_arg is a leaf (0-d runtime payload; a None simply vanishes from
+    # the leaf list), the canonical WidenSpec is static aux (frozen/hashable)
+    # so the compile cache keys on carrier form — wide vs int8-offset vs
+    # scaled-decimal columns compile distinct programs, as they must.
+    lambda c: ((c.values, c.nulls, c.carrier_arg),
+               (c.dtype, c.dictionary, c.bounds, c.carrier)),
     lambda aux, ch: DeviceColumn(aux[0], ch[0], ch[1], aux[1],
-                                 aux[2] if len(aux) > 2 else None),
+                                 aux[2] if len(aux) > 2 else None,
+                                 aux[3] if len(aux) > 3 else None,
+                                 ch[2] if len(ch) > 2 else None),
 )
 
 jax.tree_util.register_pytree_node(
@@ -431,9 +481,10 @@ def host_decode_column(arr: pa.ChunkedArray, f: Field,
 
 def device_columns(decoded: list, fields: list, cap: int,
                    device=None) -> list[DeviceColumn]:
-    """Upload host-decoded columns as DeviceColumns, narrowed losslessly for
-    the transfer (exec/codec.py) and widened back to lane dtypes on device in
-    ONE dispatch. Dead lanes (index >= n) carry the codec pad value — kernels
+    """Upload host-decoded columns as DeviceColumns, narrowed losslessly
+    (exec/codec.py) — and kept narrow: the carrier array IS the resident
+    `values` lane, with the WidenSpec riding along so operators widen at the
+    point of use. Dead lanes (index >= n) carry the codec pad value — kernels
     must never read them unmasked (they were arbitrary zeros before too)."""
     from igloo_tpu.exec.codec import upload_columns
     plans = []
@@ -446,13 +497,14 @@ def device_columns(decoded: list, fields: list, cap: int,
     cols: list[DeviceColumn] = []
     i = 0
     for f, (np_vals, null_mask, dinfo, bounds) in zip(fields, decoded):
-        dev_vals = dev[i]
+        dev_vals, spec, carg = dev[i]
         i += 1
         nulls = None
         if null_mask is not None:
-            nulls = dev[i]
+            nulls = dev[i][0]
             i += 1
-        cols.append(DeviceColumn(f.dtype, dev_vals, nulls, dinfo, bounds))
+        cols.append(DeviceColumn(f.dtype, dev_vals, nulls, dinfo, bounds,
+                                 spec, carg))
     return cols
 
 
@@ -493,23 +545,38 @@ def to_arrow(batch: DeviceBatch) -> pa.Table:
     per-array copy_to_host_async before blocking, so the host pays one device
     roundtrip instead of one per column — on a tunneled TPU a roundtrip is
     ~100ms, so per-column fetches dominated warm query time (round-2 weak #1)."""
-    host_live, host_vals, host_nulls = jax.device_get(
+    host_live, host_vals, host_nulls, host_cargs = jax.device_get(
         (batch.live, [c.values for c in batch.columns],
-         [c.nulls for c in batch.columns]))
+         [c.nulls for c in batch.columns],
+         [c.carrier_arg for c in batch.columns]))
     from igloo_tpu.utils.stats import record_fetch
     record_fetch((host_live, host_vals, host_nulls))
-    return arrow_from_host(batch, host_live, host_vals, host_nulls)
+    return arrow_from_host(batch, host_live, host_vals, host_nulls, host_cargs)
 
 
-def arrow_from_host(batch: DeviceBatch, host_live, host_vals, host_nulls) -> pa.Table:
+def arrow_from_host(batch: DeviceBatch, host_live, host_vals, host_nulls,
+                    host_cargs=None) -> pa.Table:
     """Build the pyarrow Table from already-fetched host copies of a batch's
     device buffers (see `to_arrow`; the executor also calls this directly after
-    a speculative compact-and-fetch)."""
+    a speculative compact-and-fetch). Carrier-resident columns are fetched
+    NARROW (the whole point) and widened here on the host, after the dead-lane
+    drop and before dictionary/date/timestamp decode — bit-identical to the
+    device widen (codec.host_widen)."""
+    if host_cargs is None:
+        if any(c.carrier is not None for c in batch.columns):
+            host_cargs = jax.device_get(
+                [c.carrier_arg for c in batch.columns])
+        else:
+            host_cargs = [None] * len(batch.columns)
+    from igloo_tpu.exec.codec import host_widen
     idx = np.nonzero(host_live)[0]
     arrays, fields = [], []
-    for f, c, hv, hn in zip(batch.schema, batch.columns, host_vals, host_nulls):
+    for f, c, hv, hn, hc in zip(batch.schema, batch.columns, host_vals,
+                                host_nulls, host_cargs):
         vals = hv[idx]
         nulls = hn[idx] if hn is not None else None
+        if c.carrier is not None:
+            vals = host_widen(c.carrier, vals, hc)
         if f.dtype.is_string:
             d = c.dictionary.values if c.dictionary is not None and len(c.dictionary) else np.asarray([], dtype=object)
             if len(d):

@@ -43,15 +43,22 @@ def estimated_bytes(provider) -> Optional[int]:
 
 
 def estimated_lane_bytes(provider) -> Optional[int]:
-    """Estimated size once decoded to device lanes: the raw estimate times
+    """Estimated size once RESIDENT as device lanes: the raw estimate times
     the provider's `bytes_expansion` (compressed parquet decodes to ~3-4x
     its file size as int64/float64 lanes; in-memory Arrow tables report
-    decoded bytes already, factor 1). Device-memory budget checks must use
-    THIS, not file bytes."""
+    decoded bytes already, factor 1), times the measured carrier ratio
+    (codec.carrier_ratio — columns stay NARROW in HBM since PR 16, so a
+    provider whose scans ride int8/int16 carriers prices well under its
+    wide-lane size; unmeasured providers price at ratio 1.0, the safe
+    upper bound). Every device-memory budget check — chunked tier, GRACE
+    trigger, serving's predict_hbm_bytes — flows through THIS, not file
+    bytes."""
     nb = estimated_bytes(provider)
     if nb is None:
         return None
-    return int(nb * getattr(provider, "bytes_expansion", 1.0))
+    from igloo_tpu.exec import codec
+    return int(nb * getattr(provider, "bytes_expansion", 1.0)
+               * codec.carrier_ratio(provider))
 
 
 def chunk_count(plan: L.LogicalPlan, budget_bytes: int) -> int:

@@ -7,9 +7,14 @@ BASELINE.md). A 6M-row float64 column is 48 MB on the wire even when every
 value is a whole number under 50.
 
 This codec picks, per column and on the host, the smallest *provably lossless*
-carrier representation, uploads that, and widens back to the engine lane dtype
-on device inside ONE fused jit per batch (so the widening costs one dispatch,
-not one per column). Carriers, tried narrowest-first:
+carrier representation and uploads that. Since PR 16 the carrier is also the
+RESIDENT representation: the narrow array stays in HBM as
+`DeviceColumn.values` with its `WidenSpec` attached, and operators widen
+in-jit at the point of use (`batch.wide_values`; XLA fuses the cast/divide
+into the consumer) — so HBM footprint, exchange, and spill all pay carrier
+bytes, and full lanes exist only transiently inside fused programs and at the
+Arrow output boundary (docs/compressed_execution.md). Carriers, tried
+narrowest-first:
 
 - integer family (int64/int32/date32/timestamp lanes): offset shrink —
   ``carrier = v - off`` cast to int8/int16/int32 when the value RANGE fits;
@@ -32,12 +37,32 @@ exists only because the TPU sits across an interconnect.
 from __future__ import annotations
 
 import functools
+import os
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def encoded_enabled() -> bool:
+    """Master kill switch for compressed execution (docs/compressed_execution.md).
+
+    `IGLOO_TPU_ENCODED=0` disables EVERY narrowing layer — uploads ship full
+    engine lanes, columns are never carrier-resident in HBM, exchange/GRACE
+    buffers stay decoded — which is what makes it the bit-identical A/B
+    baseline for the byte counters (`codec.*`, `exchange.bytes`, `grace.*`).
+    Read per call so tests/smokes can flip it between queries."""
+    return os.environ.get("IGLOO_TPU_ENCODED", "1") != "0"
+
+
+def rle_enabled() -> bool:
+    """Run-length transfer carrier for sorted/clustered columns
+    (`IGLOO_TPU_RLE=0` to disable; subordinate to IGLOO_TPU_ENCODED)."""
+    return encoded_enabled() and os.environ.get("IGLOO_TPU_RLE", "1") != "0"
+
 
 _I8 = (-(1 << 7), (1 << 7) - 1)
 _I16 = (-(1 << 15), (1 << 15) - 1)
@@ -113,9 +138,29 @@ _FLOAT_SCALES = (1.0, 100.0, 10000.0)
 # shrinking process-wide and those columns fall back to wide lanes
 # (f32 round-trip or raw f64). Round-5 advisor item.
 _decimal_canary_ok: Optional[bool] = None
+# two first-uploads on different threads (serving tier) must not both run the
+# canary and race the verdict write; compute-once under a lock. Tests may
+# still poke `codec._decimal_canary_ok` directly (the read below is lock-free
+# once the verdict exists).
+_canary_lock = threading.Lock()
+
+
+def reset_decimal_canary() -> None:
+    """Test-visible reset hook: forget the canary verdict so test order (or a
+    backend flip under the same process) cannot leak a stale verdict."""
+    global _decimal_canary_ok
+    with _canary_lock:
+        _decimal_canary_ok = None
 
 
 def _scaled_decimal_ok() -> bool:
+    if _decimal_canary_ok is not None:
+        return _decimal_canary_ok
+    with _canary_lock:
+        return _scaled_decimal_ok_locked()
+
+
+def _scaled_decimal_ok_locked() -> bool:
     global _decimal_canary_ok
     if _decimal_canary_ok is None:
         import jax
@@ -195,24 +240,6 @@ def shrink(np_vals: np.ndarray, lane: np.dtype):
     return None
 
 
-@functools.lru_cache(maxsize=512)
-def _widen_jit(specs: tuple, caps: tuple):
-    """One jit that widens a whole batch of carriers in a single dispatch.
-    Scales and offsets ride in as runtime vectors (see WidenSpec.widen);
-    `specs` here are the data-independent WidenSpec.key() tuples plus carrier
-    dtypes, so distinct column minima share one compiled program."""
-    def fn(arrs, scales, offsets):
-        out = []
-        for i, ((lane, scaled, scale, has_off), a) in enumerate(
-                zip(specs, arrs)):
-            spec = WidenSpec(lane, offset=1 if has_off else 0,
-                             scale=scale if scaled else 1.0)
-            out.append(spec.widen(a, scales[i] if scaled else None,
-                                  offsets[i] if has_off else None))
-        return out
-    return jax.jit(fn)
-
-
 def _pad_to(a: np.ndarray, cap: int) -> np.ndarray:
     if len(a) == cap:
         return a
@@ -221,15 +248,71 @@ def _pad_to(a: np.ndarray, cap: int) -> np.ndarray:
     return out
 
 
+# --- run-length transfer carrier --------------------------------------------
+# Sorted/clustered columns (l_shipdate-shaped after a clustered read) collapse
+# to a handful of runs; shipping (run values, run starts) instead of the full
+# carrier lane cuts H2D a further order of magnitude. RLE exists only on the
+# wire: the device expands it back to the SCALAR narrow carrier in one jit, so
+# downstream filters/segment ops run on the per-row carrier lane and nothing
+# else in the engine needs run awareness.
+
+#: engage RLE only when the column is long enough to matter and the run count
+#: is a small fraction of the rows (the two shipped arrays must clearly win)
+RLE_MIN_ROWS = 1024
+RLE_MAX_RUN_FRACTION = 8  # runs <= n // 8
+
+
+def rle_encode(arr: np.ndarray):
+    """-> (run_values, run_starts int32) | None when RLE does not pay.
+    `run_starts[0]` is always 0; run k covers rows
+    [run_starts[k], run_starts[k+1])."""
+    n = len(arr)
+    if n < RLE_MIN_ROWS or arr.dtype.kind not in ("i", "u"):
+        return None
+    change = np.nonzero(arr[1:] != arr[:-1])[0]
+    if len(change) + 1 > n // RLE_MAX_RUN_FRACTION:
+        return None
+    starts = np.concatenate([[0], change + 1]).astype(np.int32)
+    return arr[starts], starts
+
+
+def rle_decode(run_values: np.ndarray, run_starts: np.ndarray,
+               n: int) -> np.ndarray:
+    """Host-side inverse of `rle_encode` (tests / host-tier consumers)."""
+    idx = np.searchsorted(run_starts, np.arange(n), side="right") - 1
+    return run_values[idx]
+
+
+@functools.lru_cache(maxsize=256)
+def _rle_expand_jit(runs_cap: int, cap: int, dtype_name: str):
+    def fn(rv, starts):
+        idx = jnp.searchsorted(starts, jnp.arange(cap, dtype=jnp.int32),
+                               side="right") - 1
+        return jnp.take(rv, jnp.clip(idx, 0, runs_cap - 1))
+    return jax.jit(fn)
+
+
 def upload_columns(plans: list, device=None) -> list:
-    """Upload a batch of columns with narrowing, ONE widen dispatch total.
+    """Upload a batch of columns, keeping carriers RESIDENT on device.
 
     `plans` is a list of (np_array, lane_dtype | None, capacity); lane None
     means the array ships as-is after padding (bool masks). Narrowing is
-    decided over the UNPADDED values (so pad zeros cannot drag the value range)
-    and the carrier is zero-padded — a dead lane therefore widens to the
-    spec's offset, which is 0 on every path except offset-shrink. Returns the
-    device arrays in the engine lane dtypes, order preserved."""
+    decided over the UNPADDED values (so pad zeros cannot drag the value
+    range) and the carrier is zero-padded — a dead lane therefore widens to
+    the spec's offset, which is 0 on every path except offset-shrink.
+
+    Returns one (device_array, spec, carrier_arg) triple per plan, order
+    preserved. `spec` is the CANONICAL WidenSpec (offset presence only — the
+    real offset rides in `carrier_arg`, a 0-d device array, so distinct column
+    minima share compiled programs); spec None means the lane shipped wide.
+    The narrow array is what stays in HBM: operators widen in-jit through
+    `batch.wide_values` (XLA fuses the cast/divide into the consumer), so HBM
+    residency and every downstream byte cost scale with carrier width.
+
+    With IGLOO_TPU_ENCODED=0 every column ships and resides WIDE (the
+    bit-identical kill switch; also the `codec.*` counter A/B baseline).
+    Sorted/clustered integer carriers additionally ship run-length encoded
+    (IGLOO_TPU_RLE) and expand to the scalar carrier in one device jit."""
     raw_put = (jnp.asarray if device is None
                else functools.partial(jax.device_put, device=device))
     h2d = 0
@@ -239,32 +322,121 @@ def upload_columns(plans: list, device=None) -> list:
         h2d += getattr(a, "nbytes", 0)
         return raw_put(a)
 
+    from igloo_tpu.utils import tracing
+    enc = encoded_enabled()
+    rle = rle_enabled()
     out: list = [None] * len(plans)
-    widen_idx: list[int] = []
-    widen_specs: list[WidenSpec] = []
-    widen_arrs: list = []
+    carrier_bytes = 0
+    decoded_bytes = 0
     for i, (arr, lane, cap) in enumerate(plans):
-        shrunk = shrink(arr, np.dtype(lane)) if lane is not None else None
+        shrunk = shrink(arr, np.dtype(lane)) \
+            if (enc and lane is not None) else None
         if shrunk is None:
-            out[i] = put(_pad_to(arr, cap))
+            out[i] = (put(_pad_to(arr, cap)), None, None)
+            if lane is not None:
+                decoded_bytes += cap * np.dtype(lane).itemsize
+                carrier_bytes += cap * arr.dtype.itemsize
             continue
         carrier, spec = shrunk
-        widen_idx.append(i)
-        widen_specs.append(spec)
-        widen_arrs.append(put(_pad_to(carrier, cap)))
-    if widen_idx:
-        caps = tuple((a.shape, a.dtype.name) for a in widen_arrs)
-        scales = put(np.asarray([s.scale for s in widen_specs],
-                                dtype=np.float64))
-        offsets = put(np.asarray([s.offset for s in widen_specs],
-                                 dtype=np.int64))
-        wide = _widen_jit(tuple(s.key() for s in widen_specs), caps)(
-            widen_arrs, scales, offsets)
-        for i, w in zip(widen_idx, wide):
-            out[i] = w
+        decoded_bytes += cap * np.dtype(lane).itemsize
+        runs = rle_encode(carrier) if rle else None
+        if runs is not None:
+            rv, starts = runs
+            runs_cap = round_capacity_for_runs(len(rv))
+            dev_rv = put(_pad_to(rv, runs_cap))
+            # pad starts with `cap` (past every real row) so the expand's
+            # searchsorted maps dead run slots past the data
+            pstarts = np.full((runs_cap,), cap, dtype=np.int32)
+            pstarts[: len(starts)] = starts
+            dev_starts = put(pstarts)
+            vals = _rle_expand_jit(runs_cap, cap, rv.dtype.name)(
+                dev_rv, dev_starts)
+            tracing.counter("codec.rle_columns")
+            carrier_bytes += int(dev_rv.nbytes + dev_starts.nbytes)
+        else:
+            vals = put(_pad_to(carrier, cap))
+            carrier_bytes += cap * carrier.dtype.itemsize
+        # canonical spec + runtime 0-d payload: the offset is data-dependent
+        # (column min), the scale divisor must stay a runtime operand so XLA
+        # cannot rewrite the divide into an inexact reciprocal multiply
+        if spec.offset:
+            carg = put(np.int64(spec.offset))
+            cspec = WidenSpec(spec.lane, offset=1)
+        elif spec.scale != 1.0:
+            carg = put(np.float64(spec.scale))
+            cspec = WidenSpec(spec.lane, scale=spec.scale)
+        else:
+            carg = None
+            cspec = WidenSpec(spec.lane)
+        out[i] = (vals, cspec, carg)
+    if carrier_bytes:
+        tracing.counter("codec.carrier_bytes", carrier_bytes)
+    if decoded_bytes:
+        tracing.counter("codec.decoded_bytes", decoded_bytes)
     from igloo_tpu.utils.stats import record_upload
     record_upload(h2d)  # actual shipped bytes: narrowed carriers, padded
     return out
+
+
+def round_capacity_for_runs(nruns: int) -> int:
+    """Shape-bucket the RLE run arrays like every other lane so the expand
+    jit cache stays small."""
+    from igloo_tpu.exec.capacity import canonical_capacity
+    return canonical_capacity(max(nruns, 1))
+
+
+def host_widen(spec: WidenSpec, vals: np.ndarray, carg=None) -> np.ndarray:
+    """Decode a fetched carrier lane back to the engine lane ON HOST, at the
+    output boundary (batch.arrow_from_host). Bit-identical to the device
+    widen: the offset path is exact integer addition, the scale path replays
+    the very IEEE-f64 divide `_shrink_float` verified elementwise, and the
+    cast paths (f32->f64, int8->int64) are exact by construction."""
+    lane = np.dtype(spec.lane)
+    if spec.scale != 1.0:
+        return vals.astype(lane) / lane.type(spec.scale)
+    if spec.offset:
+        off = int(carg) if carg is not None else spec.offset
+        return vals.astype(lane) + lane.type(off)
+    return vals.astype(lane, copy=False)
+
+
+# --- measured carrier ratio: plan pricing in carrier bytes -------------------
+# The chunked/GRACE budget math and serving's predict_hbm_bytes estimate plans
+# in WIDE lane bytes (chunked.estimated_lane_bytes). Once a provider's columns
+# have actually shipped, the observed narrow/wide ratio is remembered PER
+# PROVIDER INSTANCE and those estimators scale by it — so more queries admit
+# concurrently and effective partitions grow per HBM budget. Keyed weakly so a
+# dropped provider cannot pin its entry; unmeasured providers price at 1.0
+# (estimates never shrink on faith).
+
+import weakref
+
+_RATIO_LOCK = threading.Lock()
+_CARRIER_RATIOS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def record_carrier_ratio(provider, narrow_bytes: int,
+                         wide_bytes: int) -> None:
+    if provider is None or wide_bytes <= 0 or not encoded_enabled():
+        return
+    ratio = min(max(narrow_bytes / wide_bytes, 0.0), 1.0)
+    try:
+        with _RATIO_LOCK:
+            _CARRIER_RATIOS[provider] = ratio
+    except TypeError:
+        pass  # non-weakref-able provider: price wide, never crash
+
+
+def carrier_ratio(provider) -> float:
+    """Measured carrier/wide byte ratio for this provider instance, or 1.0
+    when unmeasured (or the kill switch is off)."""
+    if provider is None or not encoded_enabled():
+        return 1.0
+    try:
+        with _RATIO_LOCK:
+            return _CARRIER_RATIOS.get(provider, 1.0)
+    except TypeError:
+        return 1.0
 
 
 @functools.lru_cache(maxsize=64)

@@ -1,0 +1,264 @@
+"""Self-describing carrier encoding for HOST-side Arrow tables.
+
+exec/codec.py narrows columns at the host->HBM boundary; this module applies
+the same carrier algebra to the engine's *Arrow* boundaries — cross-worker
+exchange buckets (cluster/exchange.py) and GRACE partition buffers
+(exec/grace.py) — so shipped and buffered bytes scale with carrier width, not
+engine-lane width (docs/compressed_execution.md):
+
+- integer-family columns (int64/int32/date32/timestamp[us]) offset-shrink to
+  int8/int16/int32 when the value RANGE fits (exactly codec._shrink_int);
+- float64 columns ride scaled-decimal int carriers or exact float32 when the
+  host proves losslessness (exactly codec._shrink_float, including the
+  on-device divide canary gate);
+- string columns dictionary-encode ONCE per input table, so every bucket
+  slice of a partitioned result shares one unified dictionary instead of
+  rebuilding (and re-shipping) a dictionary per record batch.
+
+The encoding is self-describing: each encoded field carries a
+``igloo_enc`` metadata JSON naming the original lane and the widen payload,
+so `decode_table` needs no side channel and is a no-op on plain tables.
+Null masks stay ordinary Arrow validity — null_count survives encoding.
+
+Two-phase API for exchange (hash-routing must see LOGICAL values — an
+offset carrier would send equal keys of the two join sides to different
+buckets): `encode_strings` first (dictionary ids hash by dictionary VALUE,
+so routing is unaffected), partition, then `apply_numeric` per bucket slice
+with ONE `plan_numeric` spec computed on the whole input (every bucket gets
+the identical encoded schema). GRACE buckets never co-hash across tables
+after partitioning, so `encode_table` does plan+apply in one step there.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from igloo_tpu.exec import codec
+
+META_KEY = b"igloo_enc"
+
+_LANE_TO_ARROW = {
+    "int64": pa.int64(), "int32": pa.int32(),
+    "float64": pa.float64(), "float32": pa.float32(),
+    "date32": pa.date32(), "timestamp[us]": pa.timestamp("us"),
+    "string": pa.string(), "large_string": pa.large_string(),
+}
+
+#: lanes whose carrier rides an integer numpy lane (what _shrink_int sees)
+_INT_NP_LANE = {"int64": np.int64, "int32": np.int32,
+                "date32": np.int32, "timestamp[us]": np.int64}
+
+
+def _lane_code(t: pa.DataType) -> Optional[str]:
+    for code, at in _LANE_TO_ARROW.items():
+        if t.equals(at):
+            return code
+    return None
+
+
+def field_spec(f: pa.Field) -> Optional[dict]:
+    """The decoded ``igloo_enc`` spec of a field, or None when unencoded."""
+    md = f.metadata
+    if not md or META_KEY not in md:
+        return None
+    return json.loads(md[META_KEY].decode())
+
+
+def is_encoded(table: pa.Table) -> bool:
+    return any(f.metadata and META_KEY in f.metadata for f in table.schema)
+
+
+def _combined(col):
+    return col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+
+
+def _tagged(name: str, typ: pa.DataType, nullable: bool, spec: dict) -> pa.Field:
+    return pa.field(name, typ, nullable,
+                    metadata={META_KEY: json.dumps(spec).encode()})
+
+
+# --- strings -----------------------------------------------------------------
+
+
+def encode_strings(table: pa.Table) -> pa.Table:
+    """Dictionary-encode every string column ONCE for the whole input. All
+    later zero-copy slices/batches of the result share the single unified
+    dictionary — Arrow IPC then ships it once per stream instead of once per
+    record batch."""
+    if not codec.encoded_enabled():
+        return table
+    for i, f in enumerate(table.schema):
+        code = _lane_code(f.type)
+        if code not in ("string", "large_string"):
+            continue
+        arr = _combined(table.column(i))
+        if not pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_encode()
+        table = table.set_column(
+            i, _tagged(f.name, arr.type, f.nullable, {"lane": code}), arr)
+    return table
+
+
+# --- numerics ----------------------------------------------------------------
+
+
+def plan_numeric(table: pa.Table) -> dict:
+    """{column name: spec} for every numeric column that provably shrinks,
+    computed over the WHOLE table so every slice encoded with this plan gets
+    an identical schema. spec fields: lane (original arrow lane code), to
+    (carrier numpy dtype name), and off | scale | f32."""
+    if not codec.encoded_enabled() or table.num_rows == 0:
+        return {}
+    out: dict = {}
+    for f in table.schema:
+        if f.metadata and META_KEY in f.metadata:
+            continue
+        code = _lane_code(f.type)
+        if code in _INT_NP_LANE:
+            lane = np.dtype(_INT_NP_LANE[code])
+            arr = _combined(table.column(f.name))
+            v = _int_values(arr, lane)
+            if v is None:
+                continue
+            shrunk = codec._shrink_int(v, lane)
+            if shrunk is None or shrunk[0].dtype.itemsize >= lane.itemsize:
+                continue
+            out[f.name] = {"lane": code, "to": shrunk[0].dtype.name,
+                           "off": shrunk[1].offset}
+        elif code == "float64":
+            arr = _combined(table.column(f.name))
+            v = np.asarray(arr.cast(pa.float64()).fill_null(0.0),
+                           dtype=np.float64)
+            if v.size == 0:
+                continue
+            shrunk = codec.shrink(v, np.dtype(np.float64))
+            if shrunk is None:
+                continue
+            carrier, spec = shrunk
+            if carrier.dtype.itemsize >= 8:
+                continue
+            if spec.scale != 1.0 or carrier.dtype.kind == "i":
+                # scaled-decimal (scale may be 1.0: integral floats). NOTE an
+                # int carrier with an offset would not survive a per-slice
+                # re-derivation; bake the global offset in
+                out[f.name] = {"lane": code, "to": carrier.dtype.name,
+                               "scale": spec.scale, "off": spec.offset}
+            else:
+                out[f.name] = {"lane": code, "to": "float32", "f32": True}
+    return out
+
+
+def _int_values(arr: pa.Array, lane: np.dtype) -> Optional[np.ndarray]:
+    """Null-safe int lane values (nulls filled with the non-null MIN so the
+    fill cannot widen the range); None when empty or all-null."""
+    import pyarrow.compute as pc
+    if len(arr) == 0 or arr.null_count == len(arr):
+        return None
+    arr = arr.cast(pa.from_numpy_dtype(lane))
+    if arr.null_count:
+        arr = pc.fill_null(arr, pc.min(arr))
+    return np.asarray(arr).astype(lane, copy=False)
+
+
+def apply_numeric(table: pa.Table, plan: dict) -> pa.Table:
+    """Encode `table`'s columns per a `plan_numeric` spec (deterministic: two
+    slices encoded with one plan get identical schemas)."""
+    if not plan:
+        return table
+    import pyarrow.compute as pc
+    for i, f in enumerate(table.schema):
+        spec = plan.get(f.name)
+        if spec is None:
+            continue
+        arr = _combined(table.column(i))
+        mask = np.asarray(arr.is_null()) if arr.null_count else None
+        to = np.dtype(spec["to"])
+        if spec.get("f32"):
+            c = np.asarray(arr.fill_null(0.0), dtype=np.float64) \
+                .astype(np.float32)
+        elif "scale" in spec:
+            v = np.asarray(arr.cast(pa.float64()).fill_null(0.0),
+                           dtype=np.float64)
+            c = (np.rint(v * spec["scale"]).astype(np.int64)
+                 - int(spec.get("off", 0))).astype(to)
+        else:
+            lane = np.dtype(_INT_NP_LANE[spec["lane"]])
+            off = int(spec["off"])
+            filled = pc.fill_null(arr.cast(pa.from_numpy_dtype(lane)), off)
+            v = np.asarray(filled).astype(lane, copy=False)
+            c = (v.astype(np.int64) - off).astype(to)
+        out = pa.array(c, mask=mask)
+        table = table.set_column(
+            i, _tagged(f.name, out.type, f.nullable, spec), out)
+    return table
+
+
+def encode_table(table: pa.Table, strings: bool = False) -> pa.Table:
+    """One-shot plan+apply for a table that is never co-hashed with another
+    (GRACE partition buffers): per-table specs are safe there because every
+    bucket decodes back to the identical logical schema before executing."""
+    if not codec.encoded_enabled():
+        return table
+    if strings:
+        table = encode_strings(table)
+    return apply_numeric(table, plan_numeric(table))
+
+
+# --- decode ------------------------------------------------------------------
+
+
+def decode_table(table: pa.Table) -> pa.Table:
+    """Inverse of the encoders, driven entirely by field metadata; a no-op on
+    plain tables. Bit-identical: integer widen is exact addition, the
+    scaled-decimal divide replays the host-verified IEEE-f64 division, f32
+    upcast is exact."""
+    if not is_encoded(table):
+        return table
+    for i, f in enumerate(table.schema):
+        spec = field_spec(f)
+        if spec is None:
+            continue
+        lane_t = _LANE_TO_ARROW[spec["lane"]]
+        arr = _combined(table.column(i))
+        if spec["lane"] in ("string", "large_string"):
+            out = arr.cast(lane_t)
+        else:
+            mask = np.asarray(arr.is_null()) if arr.null_count else None
+            v = np.asarray(arr.fill_null(0))
+            if "scale" in spec:
+                wide = (v.astype(np.int64) + int(spec.get("off", 0))) \
+                    .astype(np.float64) / np.float64(spec["scale"])
+                out = pa.array(wide, mask=mask)
+            elif spec.get("f32"):
+                out = pa.array(v.astype(np.float64), mask=mask)
+            else:
+                lane = np.dtype(_INT_NP_LANE[spec["lane"]])
+                wide = (v.astype(np.int64) + int(spec["off"])).astype(lane)
+                out = pa.array(wide, mask=mask).cast(lane_t)
+        table = table.set_column(
+            i, pa.field(f.name, out.type, f.nullable), out)
+    return table
+
+
+def column_min_max(table: pa.Table, name: str) -> Optional[tuple]:
+    """LOGICAL (lo, hi) ints of an integer-family column, decoding carrier
+    metadata instead of the values (GRACE union bounds over encoded
+    buckets). None when empty or all-null."""
+    import pyarrow.compute as pc
+    if table.num_rows == 0:
+        return None
+    col = table.column(name)
+    mm = pc.min_max(col)
+    if not mm["min"].is_valid:
+        return None
+    spec = field_spec(table.schema.field(name))
+    if spec is not None:
+        return (int(mm["min"].as_py()) + int(spec["off"]),
+                int(mm["max"].as_py()) + int(spec["off"]))
+    t = col.type
+    if pa.types.is_date(t) or pa.types.is_timestamp(t):
+        return int(mm["min"].value), int(mm["max"].value)
+    return int(mm["min"].as_py()), int(mm["max"].as_py())

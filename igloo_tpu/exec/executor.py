@@ -34,7 +34,7 @@ from igloo_tpu.exec.aggregate import (
 )
 from igloo_tpu.exec.batch import (
     DeviceBatch, DeviceColumn, DictInfo, device_columns, from_arrow,
-    host_decode_column, round_capacity, to_arrow,
+    host_decode_column, round_capacity, to_arrow, wide_values,
 )
 from igloo_tpu.exec.expr_compile import (
     Compiled, ConstPool, Env, ExprCompiler, _unify_dicts,
@@ -116,7 +116,12 @@ def batch_proto_key(batch: DeviceBatch):
     key the compile cache (round-1 verdict fix: content-keyed DictInfo in
     static aux forced a recompile for every new dictionary)."""
     return (batch.schema, batch.capacity,
-            tuple(c.nulls is not None for c in batch.columns))
+            tuple(c.nulls is not None for c in batch.columns),
+            # carrier-resident columns trace different programs (narrow lane
+            # dtypes + in-jit widens), so the carrier form is part of the
+            # prototype; data-dependent payloads (offset value) are NOT
+            tuple((str(c.values.dtype), c.carrier.key())
+                  if c.carrier is not None else None for c in batch.columns))
 
 
 def expr_fingerprint(exprs) -> str:
@@ -148,6 +153,26 @@ def attach_dicts(batch: DeviceBatch, dicts, bounds=None) -> DeviceBatch:
 def col_meta(cols) -> tuple[list, list]:
     """(dicts, bounds) of a column list, for attach_dicts after a 1:1 jit."""
     return [c.dictionary for c in cols], [c.bounds for c in cols]
+
+
+def _note_carrier_ratio(provider, batch: DeviceBatch) -> None:
+    """Record the observed HBM carrier/wide byte ratio of a freshly scanned
+    batch against its provider instance, so the chunked/GRACE/serving budget
+    math (chunked.estimated_lane_bytes) prices this table in carrier bytes."""
+    if provider is None or not batch.columns:
+        return
+    from igloo_tpu.exec.codec import record_carrier_ratio
+    narrow = wide = 0
+    for f, c in zip(batch.schema, batch.columns):
+        wide += c.capacity * np.dtype(f.dtype.device_dtype()).itemsize
+        narrow += c.values.nbytes
+    record_carrier_ratio(provider, narrow, wide)
+    if stats.detail_active():
+        # EXPLAIN ANALYZE: which scans ride carriers and how hard — resident
+        # vs would-be-wide bytes, per scan op
+        stats.annotate(encoded_lanes=sum(1 for c in batch.columns
+                                         if c.carrier is not None),
+                       carrier_bytes=narrow, decoded_bytes=wide)
 
 
 # per-query D2H accounting at the executor's fetch sites
@@ -425,10 +450,12 @@ class Executor:
         if first and self._hints is not None:
             self._hints.remove(sentinel)
             self._hints.flush()
-        flags_h, stats_h, n, host_live, host_vals, host_nulls = jax.device_get(
-            (flags, stats_dev, n_dev, spec.live,
-             [c.values for c in spec.columns],
-             [c.nulls for c in spec.columns]))
+        flags_h, stats_h, n, host_live, host_vals, host_nulls, host_cargs = \
+            jax.device_get(
+                (flags, stats_dev, n_dev, spec.live,
+                 [c.values for c in spec.columns],
+                 [c.nulls for c in spec.columns],
+                 [c.carrier_arg for c in spec.columns]))
         record_fetch((host_live, host_vals, host_nulls))
         stats.set_rows(int(n))
         for sid, v in stats_h.items():
@@ -448,7 +475,8 @@ class Executor:
             return self._retry_copy(fired).execute_to_arrow(plan)
         spec = attach_dicts(spec, meta.dicts, meta.bounds)
         if int(n) <= spec.capacity:
-            return arrow_from_host(spec, host_live, host_vals, host_nulls)
+            return arrow_from_host(spec, host_live, host_vals, host_nulls,
+                                   host_cargs)
         # result larger than the fetch window: exact compact + full fetch.
         # Clamp to the batch's own capacity (already a family member): the
         # live count can sit in the hysteresis band just under it, and an
@@ -473,16 +501,19 @@ class Executor:
         dstats = [v for _, v in stat_pairs]
         cap = self._FINAL_FETCH_CAPACITY
         if batch.capacity <= cap:
-            flags, svals, host_live, host_vals, host_nulls = jax.device_get(
-                (dvals, dstats, batch.live,
-                 [c.values for c in batch.columns],
-                 [c.nulls for c in batch.columns]))
+            flags, svals, host_live, host_vals, host_nulls, host_cargs = \
+                jax.device_get(
+                    (dvals, dstats, batch.live,
+                     [c.values for c in batch.columns],
+                     [c.nulls for c in batch.columns],
+                     [c.carrier_arg for c in batch.columns]))
             record_fetch((host_live, host_vals, host_nulls))
             self._record_stats(stat_pairs, svals)
             fired = self._fired_deferred(deferred, flags)
             if fired:
                 return self._retry_copy(fired).execute_to_arrow(plan)
-            return arrow_from_host(batch, host_live, host_vals, host_nulls)
+            return arrow_from_host(batch, host_live, host_vals, host_nulls,
+                                   host_cargs)
         fp = ("spec_compact", batch_proto_key(batch), cap)
 
         def build():
@@ -492,18 +523,20 @@ class Executor:
             return fn
         spec, n_dev = self._jitted("spec_compact", fp, build)(strip_dicts(batch))
         spec = attach_dicts(spec, *col_meta(batch.columns))
-        flags, svals, host_n, host_live, host_vals, host_nulls = \
+        flags, svals, host_n, host_live, host_vals, host_nulls, host_cargs = \
             jax.device_get(
                 (dvals, dstats, n_dev, spec.live,
                  [c.values for c in spec.columns],
-                 [c.nulls for c in spec.columns]))
+                 [c.nulls for c in spec.columns],
+                 [c.carrier_arg for c in spec.columns]))
         record_fetch((host_live, host_vals, host_nulls))
         self._record_stats(stat_pairs, svals)
         fired = self._fired_deferred(deferred, flags)
         if fired:
             return self._retry_copy(fired).execute_to_arrow(plan)
         if int(host_n) <= cap:
-            return arrow_from_host(spec, host_live, host_vals, host_nulls)
+            return arrow_from_host(spec, host_live, host_vals, host_nulls,
+                                   host_cargs)
         # overflow: compact to the exact capacity and refetch (clamped to the
         # batch's own capacity — see the fused path's compact above)
         want = min(round_capacity(int(host_n)), batch.capacity)
@@ -588,6 +621,7 @@ class Executor:
             if plan.projection is not None:
                 table = table.select(plan.projection)
             batch = from_arrow(table, schema=plan.schema)
+            _note_carrier_ratio(plan.provider, batch)
             if self._batch_cache is not None:
                 self._batch_cache.put(key, batch, snap)
             return batch
@@ -648,8 +682,10 @@ class Executor:
             live = live_lane(cap, n)
             self._batch_cache.put_entry(base + ("live",), (live, n), snap,
                                         live.nbytes, plan.table)
-        return DeviceBatch(plan.schema,
-                           [cached[f.name][0] for f in plan.schema], live)
+        out = DeviceBatch(plan.schema,
+                          [cached[f.name][0] for f in plan.schema], live)
+        _note_carrier_ratio(plan.provider, out)
+        return out
 
     def _exec_values(self, plan: L.Values) -> DeviceBatch:
         n = len(plan.rows)
@@ -1384,12 +1420,17 @@ def union_batches(batches: list[DeviceBatch], out_schema: T.Schema) -> DeviceBat
             for b in batches:
                 _, _, lut = _unify_dicts(uni, b.columns[i].dictionary)
                 luts.append(lut)
+            # ids must be WIDE (int32 lane) before the LUT remap: a carrier id
+            # lane would index the union LUT with offset-shrunk codes
             vals = jnp.concatenate([
-                _remap(b.columns[i].values, luts[j]) for j, b in enumerate(batches)])
+                _remap(wide_values(b.columns[i]), luts[j])
+                for j, b in enumerate(batches)])
             dct = uni
         else:
+            # per-input carriers generally differ across UNION branches (one
+            # spec per upload), so this boundary widens eagerly
             vals = jnp.concatenate([
-                b.columns[i].values.astype(want) for b in batches])
+                wide_values(b.columns[i]).astype(want) for b in batches])
             dct = None
         if any(b.columns[i].nulls is not None for b in batches):
             nulls = jnp.concatenate([

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from igloo_tpu import types as T
-from igloo_tpu.exec.batch import DeviceBatch, DictInfo
+from igloo_tpu.exec.batch import DeviceBatch, DictInfo, wide_values
 from igloo_tpu.plan import expr as E
 
 
@@ -40,7 +40,12 @@ class Env:
 
     @staticmethod
     def from_batch(batch: DeviceBatch, consts: tuple = ()) -> "Env":
-        return Env([c.values for c in batch.columns],
+        # wide_values is THE carrier decode point for operators: columns are
+        # carrier-resident in HBM (exec/codec.py), and every compiled
+        # expression — filters, projections, join/group/sort keys, DISTINCT —
+        # reads lanes through this Env inside a jitted program, so the widen
+        # fuses into the consumer and no wide lane ever materializes in HBM.
+        return Env([wide_values(c) for c in batch.columns],
                    [c.nulls for c in batch.columns], consts)
 
 

@@ -33,7 +33,7 @@ caller falls back to the staged executor.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Callable, Optional
 
 import jax.numpy as jnp
@@ -247,7 +247,13 @@ class FusedCompiler:
                     tuple(c.nulls is not None for c in batch.columns),
                     tuple(canonical_direct_table(b[0], b[1])
                           if b is not None else None
-                          for b in meta.bounds)))
+                          for b in meta.bounds),
+                    # carrier form shapes the traced program (widen ops +
+                    # carrier dtypes): wide vs int8-offset vs scaled columns
+                    # must key distinct fused executables
+                    tuple((str(c.values.dtype), c.carrier.key())
+                          if c.carrier is not None else None
+                          for c in batch.columns)))
 
         def fn(leaves, consts, ctx, _i=idx):
             return leaves[_i]
@@ -446,10 +452,11 @@ class FusedCompiler:
                 ctx.flags[ofid] = n > want
                 perm = K.compact_perm(ok)[:want]
                 live = jnp.take(ok, perm)
-                p_cols = [DeviceColumn(c.dtype, jnp.take(c.values, perm),
-                                       jnp.take(c.nulls, perm)
-                                       if c.nulls is not None else None,
-                                       None) for c in pb.columns]
+                p_cols = [replace(c, values=jnp.take(c.values, perm),
+                                  nulls=jnp.take(c.nulls, perm)
+                                  if c.nulls is not None else None,
+                                  dictionary=None, bounds=None)
+                          for c in pb.columns]
                 nbidx = jnp.clip(jnp.take(bidx, perm), 0, bb.capacity - 1)
                 b_cols = K.gather_batch(bb, nbidx)
                 l_cols, r_cols = (b_cols, p_cols) if swapped \

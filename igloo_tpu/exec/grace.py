@@ -71,6 +71,7 @@ import numpy as np
 import pyarrow as pa
 
 from igloo_tpu import types as T
+from igloo_tpu.exec import encoded
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
@@ -586,13 +587,16 @@ class GraceJoinExecutor:
             def prepare(p: int) -> dict:
                 provs = {}
                 for i in parted:
-                    prov = _PartitionTable(parted[i][p])
+                    # widen THIS bucket only (the others stay in carrier
+                    # form); from_arrow then re-narrows at the device edge
+                    tbl = encoded.decode_table(parted[i][p])
+                    prov = _PartitionTable(tbl)
                     bounds, udicts, cap, nullf = meta[i]
                     prov.fixed_bounds = bounds
                     if not recursive_mode:
                         from igloo_tpu.exec.batch import from_arrow
                         prov.prebuilt_batch = from_arrow(
-                            parted[i][p],
+                            tbl,
                             schema=self._leaf_of(gp, i).node.schema,
                             capacity=cap, dictionaries=udicts or None,
                             null_fields=nullf or None)
@@ -748,8 +752,17 @@ class GraceJoinExecutor:
         for tbl in self._leaf_chunks(leaf.node, depth):
             if tbl.num_rows:
                 _split_by_hash(tbl, key_name, n_parts, buckets)
-        return [pa.concat_tables(b) if b else tbl_empty_like(leaf.node.schema)
-                for b in buckets]
+        # partition buffers are the long-lived host state of the whole loop:
+        # hold them in carrier form (exec/encoded.py; numerics only — string
+        # buckets must stay plain so _union_dicts sees the raw values).
+        # prepare() widens one bucket at a time, right before upload.
+        # Per-bucket specs are safe here: buckets are never co-hashed again
+        out = [encoded.encode_table(
+                   pa.concat_tables(b) if b else
+                   tbl_empty_like(leaf.node.schema))
+               for b in buckets]
+        tracing.counter("grace.partition_bytes", sum(t.nbytes for t in out))
+        return out
 
     def _leaf_chunks(self, node: L.LogicalPlan, depth: int):
         """Yield the leaf's output host-side without ever materializing more
@@ -830,26 +843,22 @@ class GraceJoinExecutor:
         family columns (a superset range is always safe for the consumers:
         direct-join table sizing, packed-key radices — and hash partitioning
         spreads each key over its full global range anyway)."""
-        import pyarrow.compute as pc
         out: dict = {}
         for f in schema:
             if not (f.dtype.is_integer or f.dtype.is_temporal):
                 continue
             lo = hi = None
             for t in tables:
-                if t.num_rows == 0:
-                    continue
                 # min_max consumes the ChunkedArray directly — no
                 # combine_chunks/cast copies in the path that exists because
-                # host memory is already tight; temporal scalars yield their
-                # lane integers (days / microseconds) via .value
-                mm = pc.min_max(t.column(f.name))
-                if not mm["min"].is_valid:
+                # host memory is already tight. column_min_max reads LOGICAL
+                # bounds off encoded buckets without widening them (the
+                # carrier min/max plus the field's recorded offset) and
+                # yields temporal lane integers (days / microseconds)
+                mm = encoded.column_min_max(t, f.name)
+                if mm is None:
                     continue
-                if f.dtype.is_temporal:
-                    mn, mx = mm["min"].value, mm["max"].value
-                else:
-                    mn, mx = mm["min"].as_py(), mm["max"].as_py()
+                mn, mx = mm
                 lo = mn if lo is None else min(lo, mn)
                 hi = mx if hi is None else max(hi, mx)
             if lo is not None:

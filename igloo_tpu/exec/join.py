@@ -21,7 +21,7 @@ the verify lane), so differently-dictionary-encoded tables join exactly.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -31,7 +31,9 @@ import numpy as np
 from igloo_tpu import types as T
 from igloo_tpu.exec import dispatch
 from igloo_tpu.exec import kernels as K
-from igloo_tpu.exec.batch import DeviceBatch, DeviceColumn, round_capacity
+from igloo_tpu.exec.batch import (
+    DeviceBatch, DeviceColumn, round_capacity, wide_values,
+)
 from igloo_tpu.exec.expr_compile import Compiled, Env
 from igloo_tpu.sql.ast import JoinType
 from igloo_tpu.utils import tracing
@@ -308,10 +310,11 @@ def semi_anti_phase(left: DeviceBatch, right: DeviceBatch,
         ok = keyeq
         if residual is not None:
             ridx = jnp.take(order, j)
-            r_vals = [jnp.take(c.values, ridx) for c in right.columns]
+            # residual reads VALUES: widen resident carriers in-trace (fused)
+            r_vals = [jnp.take(wide_values(c), ridx) for c in right.columns]
             r_nulls = [jnp.take(c.nulls, ridx) if c.nulls is not None
                        else None for c in right.columns]
-            env = Env([c.values for c in left.columns] + r_vals,
+            env = Env([wide_values(c) for c in left.columns] + r_vals,
                       [c.nulls for c in left.columns] + r_nulls, consts)
             rv, rn = residual.fn(env)
             ok = ok & rv
@@ -377,7 +380,7 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
 
     # --- residual predicate over combined row ---
     if residual is not None:
-        env = Env([c.values for c in l_cols] + [c.values for c in r_cols],
+        env = Env([wide_values(c) for c in l_cols + r_cols],
                   [c.nulls for c in l_cols] + [c.nulls for c in r_cols], consts)
         rv, rn = residual.fn(env)
         ok = ok & rv & (~rn if rn is not None else True)
@@ -453,7 +456,10 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         else:
             nulls = None
         proto = parts_cols[0][ci]
-        out_cols.append(DeviceColumn(proto.dtype, vals, nulls, proto.dictionary))
+        # per-column carriers are consistent across parts (every part of a
+        # column gathers — or null-pads in carrier dtype — from the same
+        # source batch), so the concat output keeps the proto's spec/arg
+        out_cols.append(replace(proto, values=vals, nulls=nulls, bounds=None))
     out_live = jnp.concatenate(parts_live)
     if len(parts_live) > 1:
         # outer joins: compact the concatenated parts into contiguous rows.
@@ -461,10 +467,10 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         # above; anything that later needs compaction, e.g. resize_batch,
         # must compact first) and the argsort here costs a ~2M-lane sort
         perm = K.compact_perm(out_live)
-        out_cols = [DeviceColumn(c.dtype, jnp.take(c.values, perm),
-                                 jnp.take(c.nulls, perm)
-                                 if c.nulls is not None else None,
-                                 c.dictionary) for c in out_cols]
+        out_cols = [replace(c, values=jnp.take(c.values, perm),
+                            nulls=jnp.take(c.nulls, perm)
+                            if c.nulls is not None else None)
+                    for c in out_cols]
         out_live = jnp.take(out_live, perm)
     return DeviceBatch(out_schema, out_cols, out_live)
 
@@ -472,9 +478,12 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
 def _null_cols(batch: DeviceBatch, cap: int) -> list[DeviceColumn]:
     cols = []
     for c in batch.columns:
+        # zeros in the CARRIER dtype (concat parts must agree); an offset
+        # carrier widens pad zeros to its offset, but every pad lane is null
+        # here — masked at output, bit-identical
         vals = jnp.zeros((cap,), dtype=c.values.dtype)
-        cols.append(DeviceColumn(c.dtype, vals, jnp.ones((cap,), dtype=bool),
-                                 c.dictionary))
+        cols.append(replace(c, values=vals,
+                            nulls=jnp.ones((cap,), dtype=bool), bounds=None))
     return cols
 
 
@@ -595,7 +604,7 @@ def direct_probe(probe: DeviceBatch, build: DeviceBatch,
         b_cols = K.gather_batch(build, safe_bidx)
         p_cols = list(probe.columns)
         l_cols, r_cols = (b_cols, p_cols) if swapped else (p_cols, b_cols)
-        env = Env([c.values for c in l_cols] + [c.values for c in r_cols],
+        env = Env([wide_values(c) for c in l_cols + r_cols],
                   [c.nulls for c in l_cols] + [c.nulls for c in r_cols],
                   consts)
         rv, rn = residual.fn(env)
@@ -621,8 +630,7 @@ def direct_join_phase(probe: DeviceBatch, build: DeviceBatch,
                                       lo, table_size, swapped, residual,
                                       consts, extra_keys)
     b_cols = K.gather_batch(build, safe_bidx)
-    p_cols = [DeviceColumn(c.dtype, c.values, c.nulls, c.dictionary)
-              for c in probe.columns]
+    p_cols = [replace(c, bounds=None) for c in probe.columns]
     l_cols, r_cols = (b_cols, p_cols) if swapped else (p_cols, b_cols)
 
     # which original side is preserved / reduced to a mask
@@ -647,9 +655,8 @@ def direct_join_phase(probe: DeviceBatch, build: DeviceBatch,
         # unmatched probe rows stay inline with a null-padded build side
         main_live = probe.live
         pad = ~ok
-        b_cols = [DeviceColumn(c.dtype, c.values,
-                               pad if c.nulls is None else (c.nulls | pad),
-                               c.dictionary) for c in b_cols]
+        b_cols = [replace(c, nulls=pad if c.nulls is None
+                          else (c.nulls | pad)) for c in b_cols]
         l_cols, r_cols = (b_cols, p_cols) if swapped else (p_cols, b_cols)
     else:
         main_live = ok
@@ -681,7 +688,10 @@ def direct_join_phase(probe: DeviceBatch, build: DeviceBatch,
         else:
             nulls = None
         proto = parts_cols[0][ci]
-        out_cols.append(DeviceColumn(proto.dtype, vals, nulls, proto.dictionary))
+        # per-column carriers are consistent across parts (every part of a
+        # column gathers — or null-pads in carrier dtype — from the same
+        # source batch), so the concat output keeps the proto's spec/arg
+        out_cols.append(replace(proto, values=vals, nulls=nulls, bounds=None))
     out_live = jnp.concatenate(parts_live)
     return DeviceBatch(out_schema, out_cols, out_live), dup
 

@@ -17,6 +17,7 @@ re-designed as static-shape, whole-column XLA programs:
 """
 from __future__ import annotations
 
+from dataclasses import replace
 from typing import Optional
 
 import jax
@@ -448,7 +449,9 @@ def apply_perm(batch: DeviceBatch, perm: jax.Array) -> DeviceBatch:
         if c.nulls is not None:
             nulls = out[i]
             i += 1
-        cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
+        # replace() keeps the carrier spec/arg: a row gather permutes carrier
+        # lanes as happily as wide ones (bounds dropped, as before)
+        cols.append(replace(c, values=vals, nulls=nulls, bounds=None))
     return DeviceBatch(batch.schema, cols, out[i])
 
 
@@ -476,7 +479,7 @@ def gather_batch(batch: DeviceBatch, idx: jax.Array,
         if null_pad and valid is not None:
             pad = ~valid
             nulls = pad if nulls is None else (nulls | pad)
-        cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
+        cols.append(replace(c, values=vals, nulls=nulls, bounds=None))
     return cols
 
 
@@ -495,7 +498,7 @@ def compact_to(batch: DeviceBatch, capacity: int) -> DeviceBatch:
     for c in batch.columns:
         vals = jnp.take(c.values, perm)
         nulls = jnp.take(c.nulls, perm) if c.nulls is not None else None
-        cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
+        cols.append(replace(c, values=vals, nulls=nulls, bounds=None))
     live = jnp.take(batch.live, perm)
     if capacity > perm.shape[0]:
         return resize_batch(DeviceBatch(batch.schema, cols, live), capacity)
@@ -522,5 +525,7 @@ def resize_batch(batch: DeviceBatch, capacity: int) -> DeviceBatch:
     for c in batch.columns:
         vals = resize_to(c.values, capacity)
         nulls = resize_to(c.nulls, capacity, fill=False) if c.nulls is not None else None
-        cols.append(DeviceColumn(c.dtype, vals, nulls, c.dictionary))
+        # carrier survives a resize: the zero pad is dead lanes (masked), and
+        # a zero carrier widening to the offset is still a masked lane
+        cols.append(replace(c, values=vals, nulls=nulls, bounds=None))
     return DeviceBatch(batch.schema, cols, resize_to(batch.live, capacity, fill=False))

@@ -52,6 +52,11 @@ CHOKE_POINTS = {
     ("igloo_tpu/exec/batch.py", "to_arrow"):
         "the result fetch: one device_get for every buffer of the final "
         "batch (one round trip instead of one per column).",
+    ("igloo_tpu/exec/batch.py", "arrow_from_host"):
+        "output-boundary fallback only: callers that prefetched lanes "
+        "without carrier args pay one 0-d device_get per carrier column "
+        "to host-widen; the executor fetch sites ship host_cargs in their "
+        "single device_get and never hit it.",
     ("igloo_tpu/exec/executor.py", "Executor.execute"):
         "deferred speculative-flag fetch: flags accumulated across the "
         "query come back in one readback at the end.",
@@ -73,9 +78,11 @@ CHOKE_POINTS = {
     ("igloo_tpu/exec/executor.py", "Executor._maybe_shrink"):
         "capacity shrink between stages: one live-count sync, skipped "
         "entirely under _SYNC_FREE_CAPACITY or a known count.",
-    ("igloo_tpu/exec/codec.py", "_scaled_decimal_ok"):
+    ("igloo_tpu/exec/codec.py", "_scaled_decimal_ok_locked"):
         "one-time per-process canary: replays the scaled-decimal divide "
-        "on device before trusting it (round-5 advisor item).",
+        "on device before trusting it (round-5 advisor item; the locked "
+        "slow path of _scaled_decimal_ok — the lock-free fast read never "
+        "syncs).",
     ("igloo_tpu/parallel/executor.py", "ShardedExecutor._observed_live"):
         "mesh broadcast decision on OBSERVED rows, not padded capacity: "
         "first sight of a subtree costs one live-count sync to seed the "

@@ -81,6 +81,13 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 def _put_batch(batch: DeviceBatch, sharding: NamedSharding,
                min_capacity: int) -> DeviceBatch:
+    # mesh boundary: widen carrier-resident columns eagerly. A 0-d
+    # carrier_arg cannot take a row-partitioned spec, and shard_map programs
+    # take batch leaves under a uniform P(ROWS) — the compressed form stops
+    # at the mesh edge (exchange between WORKERS stays encoded; see
+    # cluster/exchange.py).
+    from igloo_tpu.exec.batch import materialize_batch
+    batch = materialize_batch(batch)
     if batch.capacity < min_capacity:
         from igloo_tpu.exec import kernels as K
         batch = K.resize_batch(batch, min_capacity)

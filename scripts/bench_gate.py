@@ -58,6 +58,12 @@ WATCH_COUNTERS = (
     "pallas.probe_overflow",
     "pallas.agg_overflow",
     "exchange.spills",
+    # compressed execution (docs/compressed_execution.md): carrier bytes
+    # growing toward decoded bytes, or H2D bytes growing at all, means
+    # columns stopped riding narrow carriers — a silent de-compression is
+    # a perf regression even when wall time hides it
+    "codec.carrier_bytes",
+    "xfer.h2d_bytes",
 )
 
 

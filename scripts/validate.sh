@@ -19,6 +19,9 @@ fi
 echo "== 2-worker shuffle-join smoke (fragment-tier exchange) =="
 python scripts/shuffle_smoke.py
 
+echo "== encoded smoke (compressed execution A/B: identical rows, fewer bytes) =="
+python scripts/encoded_smoke.py
+
 echo "== trace smoke (flight recorder: stitched 2-worker Perfetto trace) =="
 python scripts/trace_smoke.py
 

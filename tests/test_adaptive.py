@@ -257,13 +257,17 @@ def cluster():
     # hot probe (90% of rows on one key -> one bucket far past the B=2
     # pathological bound of 0.75) against a build side that is SHORT in rows
     # but WIDE in bytes, so the broadcast switch correctly declines and the
-    # exchange — the thing salting fixes — stays in play
+    # exchange — the thing salting fixes — stays in play. The pads must be
+    # DISTINCT per row: observed sizes are carrier bytes now, and a repeated
+    # pad collapses to one dictionary value — wide enough to decline
+    # broadcast at seed, ~4KB encoded, and broadcast would (correctly) win
     hkeys = np.where(rng.random(2500) < 0.9, 7,
                      rng.integers(0, 60, 2500)).astype(np.int64)
     horders = pa.table({"h_key": hkeys,
                         "h_val": rng.integers(0, 1000, 2500)})
     wcust = pa.table({"w_id": np.arange(60, dtype=np.int64),
-                      "w_pad": pa.array(["x" * 4096] * 60)})
+                      "w_pad": pa.array([f"{i:04d}" * 1024
+                                         for i in range(60)])})
     coord = CoordinatorServer("grpc+tcp://127.0.0.1:0", worker_timeout_s=60.0,
                               use_jit=False)
     caddr = f"127.0.0.1:{coord.port}"
