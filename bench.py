@@ -48,9 +48,15 @@ Env knobs:
     BENCH_STALL_S        kill a worker silent for this long (default 300)
     BENCH_SF10           "1" to append the SF10 Q3/Q5 block (default 1)
     BENCH_SF10_QUERIES   csv for the SF10 block (default q3,q5)
+    BENCH_HBM_BUDGET     bytes (same as --hbm-budget): memory-scaled mode —
+                         every query runs under engine.demoted(budget),
+                         forcing the out-of-core tiers; the per-query
+                         `oversized` block (incl. rows_per_s_under_budget)
+                         lands in BENCH_DETAIL.json (docs/out_of_core.md)
 """
 from __future__ import annotations
 
+import argparse
 import json
 import math
 import os
@@ -101,10 +107,12 @@ class SweepDriver:
     """Runs sweep workers under the stall watchdog; restarts past poisoned
     queries; yields per-query result records."""
 
-    def __init__(self, stage: str, queries: list, trials: int):
+    def __init__(self, stage: str, queries: list, trials: int,
+                 hbm_budget: int = 0):
         self.stage = stage
         self.queries = queries
         self.trials = trials
+        self.hbm_budget = hbm_budget
         self.poisoned: list[str] = []
         self.results: dict[str, dict] = {}
 
@@ -114,6 +122,8 @@ class SweepDriver:
                "--trials", str(self.trials),
                "--skip", ",".join(self.poisoned),
                "--deadline", str(T_START + DEADLINE_S - 30)]
+        if self.hbm_budget:
+            cmd += ["--hbm-budget", str(self.hbm_budget)]
         proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE)
         os.set_blocking(proc.stdout.fileno(), False)
@@ -215,7 +225,8 @@ class SweepDriver:
         return self.results
 
 
-def bench_block(sf: float, queries: list, trials: int) -> tuple:
+def bench_block(sf: float, queries: list, trials: int,
+                hbm_budget: int = 0) -> tuple:
     from igloo_tpu.bench.runner import ensure_staged
     from igloo_tpu.bench.tpch_pandas import PANDAS_QUERIES
 
@@ -244,13 +255,20 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
         for k in ("grace_partitions", "grace_pipeline", "counters",
                   "warm_h2d_bytes", "peak_hbm_bytes", "shuffle_buckets",
                   "exchange_bytes", "compile_cache_hits",
-                  "compile_cache_misses", "adaptive", "pallas", "topology"):
+                  "compile_cache_misses", "adaptive", "pallas", "topology",
+                  "oversized"):
             if k in rec:
                 block["queries"][q][k] = rec[k]
+        if "oversized" in block["queries"][q]:
+            # the memory-scaled gate metric: throughput the engine sustains
+            # while the out-of-core tiers hold it under the byte budget
+            block["queries"][q]["oversized"]["rows_per_s_under_budget"] = \
+                round(rps)
         log(f"{q}: cold={rec['cold_s']:.2f}s warm={med:.4f}s "
             f"[{lo:.4f},{hi:.4f}] ({rps:,.0f} rows/s)")
 
-    results = SweepDriver(stage, queries, trials).run(on_result)
+    results = SweepDriver(stage, queries, trials,
+                          hbm_budget=hbm_budget).run(on_result)
     # stalled / crashed / never-run queries still appear in the artifact
     for q, rec in results.items():
         if q not in block["queries"]:
@@ -287,13 +305,24 @@ def bench_block(sf: float, queries: list, trials: int) -> tuple:
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hbm-budget", type=int,
+                    default=int(os.environ.get("BENCH_HBM_BUDGET", "0") or 0),
+                    help="per-query byte budget: run the whole sweep under "
+                         "engine.demoted(budget), proving the out-of-core "
+                         "tiers complete every query (docs/out_of_core.md)")
+    args, _ = ap.parse_known_args()
     sf = float(os.environ.get("BENCH_SF", "1"))
     all_q = [f"q{i}" for i in range(1, 23)]
     queries = os.environ.get("BENCH_QUERIES", ",".join(all_q)).split(",")
     trials = int(os.environ.get("BENCH_TRIALS", "5"))
 
-    log(f"bench: deadline {DEADLINE_S:.0f}s, stall timeout {STALL_S:.0f}s")
-    block, ours_tp, base_tp = bench_block(sf, queries, trials)
+    log(f"bench: deadline {DEADLINE_S:.0f}s, stall timeout {STALL_S:.0f}s"
+        + (f", hbm budget {args.hbm_budget}" if args.hbm_budget else ""))
+    block, ours_tp, base_tp = bench_block(sf, queries, trials,
+                                          hbm_budget=args.hbm_budget)
+    if args.hbm_budget:
+        block["hbm_budget"] = args.hbm_budget
     detail = dict(block)
 
     # SF10 block: staging ~3 min when cold + ~1.5 GB upload through the

@@ -23,6 +23,7 @@ remaining queries cleanly rather than being killed mid-fetch.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import gc
 import json
 import sys
@@ -86,10 +87,16 @@ def _peak_hbm_bytes() -> int:
         return 0
 
 
-def run_query(engine, sql: str, trials: int) -> dict:
-    """cold -> hint-adoption re-runs -> warm trials -> result-cached run."""
+def run_query(engine, sql: str, trials: int, hbm_budget: int = 0) -> dict:
+    """cold -> hint-adoption re-runs -> warm trials -> result-cached run.
+    With `hbm_budget` every execution runs under `engine.demoted(budget)` —
+    the memory-scaled bench mode (`bench.py --hbm-budget`) that forces the
+    out-of-core tiers and records the per-query `oversized` block
+    (docs/out_of_core.md)."""
     from igloo_tpu.utils import tracing
-    with tracing.counter_delta() as query_delta:
+    budget_cm = engine.demoted(budget_bytes=hbm_budget) if hbm_budget \
+        else contextlib.nullcontext()
+    with budget_cm, tracing.counter_delta() as query_delta:
         with tracing.counter_delta() as cold_delta:
             t0 = time.perf_counter()
             engine.execute(sql)
@@ -188,6 +195,20 @@ def run_query(engine, sql: str, trials: int) -> dict:
         "mesh_devices": int(mesh.devices.size) if mesh is not None else 1,
         "sharded": mesh is not None and not routed_elsewhere,
     }
+    if hbm_budget:
+        # the per-query out-of-core record for the memory-scaled mode: what
+        # budget it ran under, which tier took it, how many partitions, and
+        # how many bytes actually spilled — the rows/s-under-budget curve
+        # (bench.py adds rows_per_s_under_budget) rides into BENCH_DETAIL
+        # and the bench_gate WATCH list so the SF10 cliff cannot return
+        rec["oversized"] = {
+            "budget_bytes": int(hbm_budget),
+            "completed": True,
+            "grace": query_delta.get("engine.grace_route") > 0,
+            "chunked": query_delta.get("engine.chunked_route") > 0,
+            "grace_partitions": query_delta.get("grace.partitions"),
+            "spill_bytes": query_delta.get("exchange.spill_bytes"),
+        }
     joins = query_delta.get("grace.join")
     rec["grace"] = query_delta.get("engine.grace_route") > 0
     if rec["grace"]:
@@ -209,6 +230,9 @@ def main(argv=None) -> int:
     ap.add_argument("--skip", default="", help="csv of poisoned query ids")
     ap.add_argument("--deadline", type=float, default=0.0,
                     help="unix epoch seconds; skip queries past this")
+    ap.add_argument("--hbm-budget", type=int, default=0,
+                    help="bytes: run every query under "
+                         "engine.demoted(budget) — the memory-scaled mode")
     args = ap.parse_args(argv)
 
     from igloo_tpu.bench.tpch import QUERIES
@@ -232,7 +256,8 @@ def main(argv=None) -> int:
         log(f"SWEEP-START {q}")
         t0 = time.perf_counter()
         try:
-            rec = run_query(engine, QUERIES[q], args.trials)
+            rec = run_query(engine, QUERIES[q], args.trials,
+                            hbm_budget=args.hbm_budget)
         except Exception as e:  # record, keep sweeping
             log(f"{q}: FAILED {type(e).__name__}: {e}")
             print(json.dumps({"q": q,

@@ -268,12 +268,13 @@ class DistributedExecutor:
                 qid: Optional[str] = None, sql: str = "",
                 adaptive_info: Optional[list] = None,
                 extra_metrics: Optional[dict] = None,
-                trace: Optional[flight_recorder.Trace] = None) -> pa.Table:
+                trace: Optional[flight_recorder.Trace] = None,
+                budget: Optional[int] = None) -> pa.Table:
         schema, gen = self.execute_stream(fragments, deadline_s=deadline_s,
                                           qid=qid, sql=sql,
                                           adaptive_info=adaptive_info,
                                           extra_metrics=extra_metrics,
-                                          trace=trace)
+                                          trace=trace, budget=budget)
         return pa.Table.from_batches(list(gen), schema=schema)
 
     def execute_stream(self, fragments: list[QueryFragment],
@@ -281,7 +282,8 @@ class DistributedExecutor:
                        qid: Optional[str] = None, sql: str = "",
                        adaptive_info: Optional[list] = None,
                        extra_metrics: Optional[dict] = None,
-                       trace: Optional[flight_recorder.Trace] = None
+                       trace: Optional[flight_recorder.Trace] = None,
+                       budget: Optional[int] = None
                        ) -> tuple[pa.Schema, object]:
         """Run the fragment waves, then return (schema, batch generator)
         streaming the root result from its worker — the coordinator never
@@ -332,6 +334,10 @@ class DistributedExecutor:
                          # THIS thread — the dispatch pool can't read it)
                          "_trace": trace,
                          "_trace_root": flight_recorder.current_root(),
+                         # per-worker out-of-core budget of an OVERSIZED
+                         # query (docs/out_of_core.md): shipped inside every
+                         # dispatch so workers stream-spill / GRACE under it
+                         "_budget": budget,
                          "trace_id": trace.trace_id if trace is not None
                          else ""}
         if extra_metrics:
@@ -657,7 +663,8 @@ class DistributedExecutor:
                 req = protocol.DISPATCH.build(id=f.id, plan=f.plan,
                                               deps=deps,
                                               timeout_s=timeout_s,
-                                              trace=ctx)
+                                              trace=ctx,
+                                              budget=metrics.get("_budget"))
                 # retries=0: re-dispatch is the RECOVERY layer's job — an
                 # RPC-level retry against the same hung worker would just
                 # double the time a dead worker stalls the wave. The
@@ -993,9 +1000,16 @@ class CoordinatorServer(flight.FlightServerBase):
         """The admitted execution body: distributed when possible, local
         fallback otherwise, with the degradation ladder absorbing OOM."""
         if permit.demote:
-            # predicted past the WHOLE HBM budget: no concurrency setting
-            # makes it fit, so run it straight through the budget-
-            # constrained ladder instead of letting it crash first
+            # predicted past the WHOLE HBM budget: first try to spread the
+            # over-budget join across the fleet (GRACE partitions become
+            # exchange buckets, each worker spills and streams its share —
+            # docs/out_of_core.md); when the fleet or plan can't take it,
+            # fall back to the exact single-node degradation ladder
+            out = self._try_oversized_distributed(
+                plan, sql, stream, deadline, deadline_s, qid, permit, rkey,
+                trace=trace)
+            if out is not None:
+                return out
             return self._run_demoted(sql, stream, deadline, t_start, permit)
         live = self.membership.live()
         if not live:
@@ -1089,6 +1103,78 @@ class CoordinatorServer(flight.FlightServerBase):
                                           permit.priority, level=1)
         return (out.schema, iter(out.to_batches())) if stream else out
 
+    def _try_oversized_distributed(self, plan, sql: str, stream: bool,
+                                   deadline: Optional[float],
+                                   deadline_s: Optional[float],
+                                   qid: Optional[str],
+                                   permit: "serving.Permit", rkey,
+                                   trace: Optional[
+                                       flight_recorder.Trace] = None):
+        """Distributed out-of-core attempt for an oversized query: plan the
+        over-budget join as per-bucket fragments whose buckets ARE its GRACE
+        partitions (cluster/fragment.py `_try_grace_distributed`), spread
+        across the live workers, each dispatch carrying the per-worker
+        budget so Exchange fragments stream-spill under it. Returns None
+        whenever the fleet or the plan can't take it — fewer than two
+        synced workers, a non-distributable plan, the planner declining
+        (`grace_info` unset), the `IGLOO_GRACE_DISTRIBUTED=0` kill switch,
+        or an execution failure — and the caller falls back to the exact
+        single-node ladder, byte-identical to the pre-distributed behavior."""
+        live = self.membership.live()
+        if len(live) < 2:
+            return None
+        synced = []
+        for w in live:
+            try:
+                self._sync_worker_tables(w)
+                synced.append(w)
+            except Exception:
+                self.membership.evict(w.worker_id)
+        live = synced
+        if len(live) < 2 or not self._distributable(plan):
+            return None
+        budget = self._demote_budget()
+        topo = {w.addr: w.devices for w in live}
+        planner = DistributedPlanner([w.addr for w in live], topology=topo,
+                                     budget_bytes=budget)
+        try:
+            frags = planner.plan(plan)
+        except Exception:
+            tracing.counter("grace.distributed_planfail")
+            return None
+        if planner.grace_info is None:
+            return None
+        tracing.counter("coordinator.distributed_queries")
+        from igloo_tpu.plan.optimizer import last_adaptive_decisions
+        adaptive_info = last_adaptive_decisions() + planner.adaptive_info
+        extra = {"queue_wait_s": round(permit.wait_s, 6),
+                 "priority": permit.priority, "demoted": 0,
+                 # per-query out-of-core attribution, published in
+                 # last_metrics and the sweep JSON `oversized` block
+                 "oversized": dict(planner.grace_info),
+                 "topology": {"workers": len(live),
+                              "devices": topo,
+                              "total_shards": sum(topo.values())}}
+        try:
+            # materialized (not relay-streamed) even for stream callers:
+            # the caller must still be able to fall back to the exact
+            # ladder if a worker dies or OOMs mid-query, which is
+            # impossible once a stream has been handed out. Oversized
+            # results are post-aggregate and small; the BUCKETS never
+            # gather here.
+            table = self.executor.execute(frags, deadline_s=deadline_s,
+                                          qid=qid, sql=sql,
+                                          adaptive_info=adaptive_info,
+                                          extra_metrics=extra, trace=trace,
+                                          budget=budget)
+        except (QueryCancelledError, DeadlineExceededError, serving.ServerBusy):
+            raise
+        except Exception:
+            tracing.counter("grace.distributed_fallback")
+            return None
+        self._result_cache_put(rkey, table)
+        return (table.schema, iter(table.to_batches())) if stream else table
+
     def _run_demoted(self, sql: str, stream: bool,
                      deadline: Optional[float], t_start: float,
                      permit: "serving.Permit"):
@@ -1098,6 +1184,13 @@ class CoordinatorServer(flight.FlightServerBase):
                                    priority=permit.priority):
             out = self._demote_ladder(sql, deadline, t_start,
                                       permit.priority, level=1)
+        # publish: a demoted query must overwrite last_metrics (clients —
+        # and the kill-switch A/B — would otherwise read the PREVIOUS
+        # query's oversized/fragment attribution as this one's)
+        self.executor.last_metrics = {
+            "qid": "", "status": "ok", "rows": out.num_rows,
+            "fragments": [], "recoveries": 0, "demoted": 1,
+            "execution_time_s": round(time.time() - t_start, 6)}
         return (out.schema, iter(out.to_batches())) if stream else out
 
     def _demote_ladder(self, sql: str, deadline: Optional[float],

@@ -223,10 +223,59 @@ class _Stored:
     # UNSALTED per-bucket row counts: the skew sketch the coordinator
     # records into AdaptiveStats (salting must not mask the skew signal)
     base_rows: Optional[list] = None
+    # streaming entries (StreamingPut): per-bucket lists of spill SEGMENT
+    # paths written while the result was still arriving — a bucket's full
+    # content is its segments' batches followed by its resident range
+    bucket_files: Optional[list] = None
 
 
 def _chunk(table: pa.Table) -> list:
     return table.to_batches(max_chunksize=BATCH_ROWS)
+
+
+def measured_nbytes(batches) -> int:
+    """Resident bytes of a batch list with shared buffers counted ONCE.
+    Bucket slices of one reordered table share its physical buffers, and
+    every slice of a dictionary column references the WHOLE unified
+    dictionary — so summing per-batch `nbytes` prices that dictionary once
+    PER BUCKET and the spill budget evicts 3-4x early on dictionary/
+    carrier-heavy results. Buffer-address dedupe measures what is actually
+    resident."""
+    seen: set = set()
+    total = 0
+
+    def add(arr):
+        nonlocal total
+        for buf in arr.buffers():
+            if buf is not None and buf.address not in seen:
+                seen.add(buf.address)
+                total += buf.size
+    for b in batches:
+        for col in b.columns:
+            d = getattr(col, "dictionary", None)
+            if d is not None:
+                add(d)
+            add(col)
+    return total
+
+
+def _plain(table: pa.Table) -> pa.Table:
+    """Dictionary columns cast to their value type. Streaming spill segments
+    are written incrementally to Arrow IPC files, and the FILE format forbids
+    the dictionary replacement that per-chunk dictionaries would need — so
+    the streaming path stores plain lanes and leaves dictionary unification
+    to the no-spill finish (which rides the classic encoded path)."""
+    if not any(pa.types.is_dictionary(f.type) for f in table.schema):
+        return table
+    cols, fields = [], []
+    for i, f in enumerate(table.schema):
+        col = table.column(i)
+        if pa.types.is_dictionary(f.type):
+            col = col.cast(f.type.value_type)
+            f = pa.field(f.name, f.type.value_type, f.nullable)
+        cols.append(col)
+        fields.append(f)
+    return pa.table(cols, schema=pa.schema(fields))
 
 
 class FragmentStore:
@@ -293,8 +342,13 @@ class FragmentStore:
                                  "bytes": sum(b.nbytes for b in bs)})
             tracing.counter("exchange.partitions")
             tracing.counter("exchange.partition_rows", table.num_rows)
+            # MEASURED resident bytes, shared buffers counted once: the
+            # bucket slices view ONE reordered table (and one unified
+            # dictionary per string column), so per-batch nbytes sums would
+            # over-report 3-4x on dictionary/carrier-heavy results and make
+            # the spill budget evict that much early
             ent = _Stored(schema=schema, batches=batches,
-                          nbytes=sum(b.nbytes for b in batches),
+                          nbytes=measured_nbytes(batches),
                           nbuckets=len(slices), ranges=ranges, meta=meta,
                           rows=table.num_rows,
                           base_rows=[int(c) for c in base])
@@ -302,8 +356,27 @@ class FragmentStore:
         else:
             batches = _chunk(table)
             ent = _Stored(schema=table.schema, batches=batches,
-                          nbytes=sum(b.nbytes for b in batches),
+                          nbytes=measured_nbytes(batches),
                           rows=table.num_rows)
+        return self._install(frag_id, ent)
+
+    def stream_put(self, frag_id: str, keys: list[int], nbuckets: int,
+                   salt: Optional[tuple] = None,
+                   budget_bytes: Optional[int] = None) -> "StreamingPut":
+        """Incremental hash-partitioned write: the caller appends row-group
+        sized chunks as they arrive (the streaming exchange — the producer
+        never materializes its whole result), and `finish()` installs the
+        entry. Chunks are hash-routed into per-bucket accumulators on
+        append; when resident bytes cross half of `budget_bytes` (the QUERY
+        out-of-core budget; defaults to the store budget) every bucket's
+        resident batches flush to its open IPC segment file. A result that
+        never spilled finishes through the classic encoded `put` path
+        (dictionary unification + numeric narrowing intact,
+        docs/compressed_execution.md)."""
+        return StreamingPut(self, frag_id, keys, nbuckets, salt,
+                            budget_bytes=budget_bytes)
+
+    def _install(self, frag_id: str, ent: _Stored) -> _Stored:
         # a `__dep_<fid>:...` slice is released alongside fragment <fid>, so
         # its orphan check keys on the owning fragment id
         base = frag_id
@@ -312,12 +385,35 @@ class FragmentStore:
         with self._lock:
             if frag_id in self._released or base in self._released:
                 tracing.counter("exchange.orphan_dropped")
+                self._drop_files_of(ent)
                 return ent
             self._seq += 1
             ent.seq = self._seq
             self._entries[frag_id] = ent
             self._enforce_budget_locked()
         return ent
+
+    @staticmethod
+    def _drop_files_of(ent: _Stored) -> None:
+        paths = list(ent.bucket_files and
+                     [p for fs in ent.bucket_files for p in fs] or [])
+        if ent.spill_path:
+            paths.append(ent.spill_path)
+        for p in paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _segment_path_locked(self, name: str) -> str:
+        if self._tmpdir is None:
+            self._tmpdir = tempfile.mkdtemp(prefix="igloo-fragstore-")
+        return os.path.join(self._tmpdir,
+                            f"{name}.arrow".replace("/", "_"))
+
+    def _segment_path(self, name: str) -> str:
+        with self._lock:
+            return self._segment_path_locked(name)
 
     def _enforce_budget_locked(self) -> None:
         while self.resident_bytes_locked() > self.budget_bytes:
@@ -338,9 +434,27 @@ class FragmentStore:
 
     def _spill_locked(self, frag_id: str) -> None:
         ent = self._entries[frag_id]
-        if self._tmpdir is None:
-            self._tmpdir = tempfile.mkdtemp(prefix="igloo-fragstore-")
-        path = os.path.join(self._tmpdir, f"{frag_id}.arrow".replace("/", "_"))
+        if ent.bucket_files is not None:
+            # streaming entry: the resident TAIL of each bucket moves to a
+            # new per-bucket segment (appended after the ones StreamingPut
+            # wrote), so bucket addressing survives the spill
+            with tracing.span("exchange.spill", bytes=ent.nbytes):
+                for b in range(ent.nbuckets):
+                    start, count = ent.ranges[b]
+                    if count <= 0:
+                        continue
+                    path = self._segment_path_locked(f"{frag_id}.b{b}.tail")
+                    with pa.OSFile(path, "wb") as f, \
+                            pa.ipc.new_file(f, ent.schema) as w:
+                        for batch in ent.batches[start:start + count]:
+                            w.write_batch(batch)
+                    ent.bucket_files[b].append(path)
+            ent.batches = None
+            ent.ranges = [(0, 0)] * ent.nbuckets
+            tracing.counter("exchange.spills")
+            tracing.counter("exchange.spill_bytes", ent.nbytes)
+            return
+        path = self._segment_path_locked(frag_id)
         with tracing.span("exchange.spill", bytes=ent.nbytes):
             with pa.OSFile(path, "wb") as f, \
                     pa.ipc.new_file(f, ent.schema) as w:
@@ -357,11 +471,8 @@ class FragmentStore:
                 self._released[fid] = None
                 self._released.move_to_end(fid)
                 ent = self._entries.pop(fid, None)
-                if ent is not None and ent.spill_path:
-                    try:
-                        os.unlink(ent.spill_path)
-                    except OSError:
-                        pass
+                if ent is not None:
+                    self._drop_files_of(ent)
             while len(self._released) > TOMBSTONE_CAP:
                 self._released.popitem(last=False)
 
@@ -412,8 +523,30 @@ class FragmentStore:
                                                          nbuckets)
             batches = list(ent.batches) if ent.batches is not None else None
             spill = ent.spill_path
+            files = ([list(fs) for fs in ent.bucket_files]
+                     if ent.bucket_files is not None else None)
 
         def gen():
+            if files is not None:
+                # streaming entry: a bucket is its spill segments' batches
+                # followed by its resident tail; a whole-fragment read walks
+                # every bucket (consumers concat, order is irrelevant)
+                sel_files = [p for fs in files for p in fs] if bucket is None \
+                    else list(files[bucket])
+                for path in sel_files:
+                    src = pa.OSFile(path, "rb")
+                    try:
+                        reader = pa.ipc.open_file(src)
+                        for i in range(reader.num_record_batches):
+                            yield reader.get_batch(i)
+                    finally:
+                        src.close()
+                if batches is not None:
+                    sel = batches if count < 0 \
+                        else batches[start:start + count]
+                    for b in sel:
+                        yield b
+                return
             if batches is not None:
                 sel = batches if count < 0 else batches[start:start + count]
                 for b in sel:
@@ -434,3 +567,155 @@ class FragmentStore:
                   nbuckets: Optional[int] = None) -> pa.Table:
         schema, it = self.stream(frag_id, bucket, nbuckets)
         return pa.Table.from_batches(list(it), schema=schema)
+
+
+class StreamingPut:
+    """Incremental hash-partitioned writer (one producer thread; the store's
+    lock guards only the shared install/segment-path steps).
+
+    `append` routes each row-group-sized chunk into per-bucket accumulators;
+    when routed-but-unflushed bytes cross the flush threshold (half the store
+    budget) EVERY bucket's resident batches are appended to that bucket's open
+    IPC segment file and dropped. Flushing all buckets — not just the largest
+    — is what actually frees memory: the bucket slices of one routed chunk
+    are zero-copy views of a single reordered table, so holding any one of
+    them holds them all.
+
+    `finish` installs the entry. A result that never flushed is re-submitted
+    through the classic encoded `put` (dictionary-unify once, narrow per
+    slice); proven-small data pays one extra in-RAM hash pass to keep the
+    PR 16 carrier savings. A flushed result installs as a `bucket_files`
+    entry: plain lanes, per-bucket segment files plus the resident tail."""
+
+    def __init__(self, store: FragmentStore, frag_id: str, keys: list[int],
+                 nbuckets: int, salt: Optional[tuple],
+                 budget_bytes: Optional[int] = None):
+        self._store = store
+        self._frag_id = frag_id
+        self._keys = list(keys)
+        self._nbuckets = int(nbuckets)
+        self._salt = salt
+        extra = max(int(salt[1]) - 1, 0) if salt is not None else 0
+        self._total = self._nbuckets + extra
+        # flush threshold tracks the QUERY's out-of-core budget when given
+        # (the worker store's own budget is sized for caching, not spilling)
+        base_budget = budget_bytes if budget_bytes else store.budget_bytes
+        self._flush_bytes = max(base_budget // 2, 1 << 19)
+        self._schema: Optional[pa.Schema] = None
+        self._buckets: list[list] = [[] for _ in range(self._total)]
+        self._bucket_rows = [0] * self._total
+        self._bucket_bytes = [0] * self._total
+        self._base = np.zeros(self._nbuckets, dtype=np.int64)
+        self._rows = 0
+        self._bytes = 0
+        self._resident = 0
+        self._spilled = False
+        # per-bucket (path, OSFile, ipc writer) — opened at first flush of
+        # the bucket, closed in finish()/abort(); the IPC FILE footer only
+        # lands on close, and nothing reads a segment before install
+        self._writers: list = [None] * self._total
+
+    def append(self, table: pa.Table) -> None:
+        table = _plain(table)
+        if self._schema is None:
+            self._schema = table.schema
+        elif table.schema != self._schema:
+            table = table.cast(self._schema)
+        if table.num_rows == 0:
+            return
+        tracing.counter("exchange.stream_chunks")
+        slices, base = salted_partition(table, self._keys, self._nbuckets,
+                                        self._salt)
+        self._base += base
+        self._rows += table.num_rows
+        chunk_batches = []
+        for b, s in enumerate(slices):
+            if s.num_rows == 0:
+                continue
+            bs = _chunk(s)
+            self._buckets[b].extend(bs)
+            self._bucket_rows[b] += s.num_rows
+            self._bucket_bytes[b] += sum(x.nbytes for x in bs)
+            chunk_batches.extend(bs)
+        got = measured_nbytes(chunk_batches)
+        self._resident += got
+        self._bytes += got
+        if self._resident > self._flush_bytes:
+            self._flush()
+
+    def _writer(self, b: int):
+        if self._writers[b] is None:
+            path = self._store._segment_path(f"{self._frag_id}.b{b}")
+            f = pa.OSFile(path, "wb")
+            self._writers[b] = (path, f, pa.ipc.new_file(f, self._schema))
+        return self._writers[b][2]
+
+    def _flush(self) -> None:
+        with tracing.span("exchange.spill", bytes=self._resident,
+                          streaming=True):
+            for b in range(self._total):
+                bs = self._buckets[b]
+                if not bs:
+                    continue
+                w = self._writer(b)
+                for batch in bs:
+                    w.write_batch(batch)
+                self._buckets[b] = []
+        tracing.counter("exchange.spills")
+        tracing.counter("exchange.spill_bytes", self._resident)
+        self._resident = 0
+        self._spilled = True
+
+    def _close_writers(self) -> list[list[str]]:
+        files: list[list[str]] = [[] for _ in range(self._total)]
+        for b, w in enumerate(self._writers):
+            if w is None:
+                continue
+            path, f, writer = w
+            writer.close()
+            f.close()
+            files[b] = [path]
+            self._writers[b] = None
+        return files
+
+    def finish(self) -> _Stored:
+        if self._schema is None:
+            raise ValueError("stream_put finished without any append")
+        if not self._spilled:
+            # proved under budget: one concat + the classic encoded put
+            whole = pa.Table.from_batches(
+                [b for bs in self._buckets for b in bs], schema=self._schema)
+            self._buckets = [[] for _ in range(self._total)]
+            return self._store.put(self._frag_id, whole,
+                                   partition=(self._keys, self._nbuckets),
+                                   salt=self._salt)
+        files = self._close_writers()
+        batches, ranges, meta = [], [], []
+        for b in range(self._total):
+            bs = self._buckets[b]
+            ranges.append((len(batches), len(bs)))
+            batches.extend(bs)
+            meta.append({"rows": self._bucket_rows[b],
+                         "bytes": self._bucket_bytes[b]})
+        ent = _Stored(schema=self._schema, batches=batches,
+                      nbytes=measured_nbytes(batches),
+                      nbuckets=self._total, ranges=ranges, meta=meta,
+                      rows=self._rows,
+                      base_rows=[int(c) for c in self._base],
+                      bucket_files=files)
+        tracing.counter("exchange.partitions")
+        tracing.counter("exchange.partition_rows", self._rows)
+        tracing.counter("exchange.partition_bytes", self._bytes)
+        return self._store._install(self._frag_id, ent)
+
+    def abort(self) -> None:
+        """Drop everything (producer failed mid-stream): close and unlink
+        any segment files, release the accumulators."""
+        for files in self._close_writers():
+            for p in files:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        self._buckets = [[] for _ in range(self._total)]
+        self._resident = 0

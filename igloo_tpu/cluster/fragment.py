@@ -127,6 +127,19 @@ def _copy_expr(e):
     return copy.deepcopy(e) if e is not None else None
 
 
+def _rewrap(nodes: list, inner: L.LogicalPlan) -> L.LogicalPlan:
+    """Re-apply upper-path nodes (root-first, as find_grace_join peeled them)
+    over `inner`: shallow node copies with the input swapped — expressions
+    stay shared, which is safe because _make_fragment serializes each
+    fragment's plan to JSON at creation time."""
+    import copy
+    for nd in reversed(nodes):
+        c = copy.copy(nd)
+        c.input = inner
+        inner = c
+    return inner
+
+
 def _col(i: int, dtype: T.DataType, name: str = "") -> E.Expr:
     c = E.Column(name=name or f"c{i}", index=i)
     c.dtype = dtype
@@ -189,7 +202,8 @@ class DistributedPlanner:
 
     def __init__(self, workers: list[str], partitions_per_worker: int = 1,
                  shuffle_buckets: Optional[int] = None,
-                 topology: Optional[dict] = None):
+                 topology: Optional[dict] = None,
+                 budget_bytes: Optional[int] = None):
         if not workers:
             raise ValueError("no workers")
         self.workers = list(workers)
@@ -220,10 +234,29 @@ class DistributedPlanner:
         # per-join decision records, published into last_metrics["adaptive"]
         # and the sweep JSON so every plan choice is attributable
         self.adaptive_info: list[dict] = []
+        # distributed out-of-core (docs/out_of_core.md): with a per-host
+        # budget, an over-budget join tree fragments into per-GRACE-partition
+        # bucket joins spread across the fleet instead of demoting to the
+        # single-node ladder. IGLOO_GRACE_DISTRIBUTED=0 preserves today's
+        # plans bit-identically (the coordinator never passes a budget).
+        self.budget_bytes = budget_bytes
+        self.grace_enabled = \
+            os.environ.get("IGLOO_GRACE_DISTRIBUTED", "1") != "0"
+        # set when plan() took the grace path: {"buckets", "partitioned_
+        # leaves", "replicated_leaves", "budget_bytes"} — the coordinator
+        # publishes it as the query's `oversized` metrics block
+        self.grace_info: Optional[dict] = None
 
     def plan(self, plan: L.LogicalPlan) -> list[QueryFragment]:
         """-> fragments in dependency-safe order; the LAST one is the root."""
         frags: list[QueryFragment] = []
+        if self.budget_bytes and self.grace_enabled and \
+                len(self.workers) >= 2:
+            root_plan = self._try_grace_distributed(plan, frags)
+            if root_plan is not None:
+                self._make_fragment(root_plan, frags_out=frags)
+                return frags
+            frags.clear()
         root_plan = self._split(plan, frags)
         self._make_fragment(root_plan, frags_out=frags)  # appends the root
         return frags
@@ -394,6 +427,114 @@ class DistributedPlanner:
         u = L.Union(inputs=join_scans)
         u.schema = p.schema
         return u
+
+    # --- distributed out-of-core GRACE (docs/out_of_core.md) ---
+
+    def _try_grace_distributed(self, plan: L.LogicalPlan,
+                               frags: list[QueryFragment]
+                               ) -> Optional[L.LogicalPlan]:
+        """Over-budget join tree -> per-bucket join fragments whose buckets
+        ARE the GRACE partitions: exec/grace.py's partition scheme (key
+        equivalence classes + anchor-analysis validity + budget-derived
+        partition count) lifted to the fleet. Every partitioned leaf becomes
+        Exchange fragments hash-routing into B buckets (streamed +
+        spill-backed on the worker, cluster/exchange.py StreamingPut);
+        replicated leaves ship whole; bucket b's join fragment unions bucket
+        b of every partitioned side and runs wherever the device-weighted
+        placement puts it. Returns the root plan, or None when the plan does
+        not qualify — the caller falls back to the normal split (and the
+        coordinator to the single-node demote ladder)."""
+        from igloo_tpu.exec import grace
+        gp = grace.find_grace_join(plan, self.budget_bytes)
+        if gp is None:
+            return None
+        part = [lf for lf in gp.leaves if lf.key_col is not None]
+        rep = [lf for lf in gp.leaves if lf.key_col is None]
+        if not part:
+            return None
+        if any(lf.node.schema is None for lf in gp.leaves):
+            return None
+        for lf in part:
+            # partitioned leaves must be shippable scan chains (the Exchange
+            # fragment re-executes them partition-at-a-time on the worker)
+            if not _is_local(lf.node) or isinstance(lf.node, L.Values):
+                return None
+        B = min(max(gp.n_parts, len(self.workers) * self.ppw),
+                grace.MAX_GRACE_PARTITIONS)
+        with tracing.span("grace.distributed", buckets=B,
+                          partitioned=len(part), replicated=len(rep),
+                          budget=int(self.budget_bytes)):
+            leaf_sub: dict[int, tuple] = {}
+            for lf in rep:
+                f = self._make_fragment(L.copy_plan(lf.node), frags,
+                                        deps=[], kind="scan")
+                leaf_sub[id(lf.node)] = (False, [f])
+            for lf in part:
+                lfr = self._exchange_fragments(lf.node, [lf.key_col], B,
+                                               frags)
+                leaf_sub[id(lf.node)] = (True, lfr)
+
+            def rebuild(n: L.LogicalPlan, b: int) -> L.LogicalPlan:
+                if id(n) in leaf_sub:
+                    bucketed, lfr = leaf_sub[id(n)]
+                    if bucketed:
+                        return _bucket_union(lfr, b, B, n.schema)
+                    return _whole_union(lfr, n.schema)
+                if isinstance(n, L.Filter):
+                    f = L.Filter(input=rebuild(n.input, b),
+                                 predicate=_copy_expr(n.predicate))
+                    f.schema = n.schema
+                    return f
+                j = L.Join(left=rebuild(n.left, b),
+                           right=rebuild(n.right, b),
+                           join_type=n.join_type,
+                           left_keys=[_copy_expr(k) for k in n.left_keys],
+                           right_keys=[_copy_expr(k) for k in n.right_keys],
+                           residual=_copy_expr(n.residual))
+                j.schema = n.schema
+                return j
+
+            # the upper path splits at the aggregate: nodes BELOW it run
+            # inside every bucket fragment (ahead of the partial aggregate),
+            # nodes ABOVE it wrap the final merge in the root fragment
+            above, below = gp.path, []
+            partial_schema = partial_aggs = partial_names = final_plan = None
+            if gp.agg is not None:
+                ai = gp.path.index(gp.agg)
+                above, below = gp.path[:ai], gp.path[ai + 1:]
+                partial_schema, partial_aggs, partial_names, final_plan = \
+                    decompose_aggregate(gp.agg)
+            placement = self._bucket_placement(B)
+            bucket_scans: list[L.LogicalPlan] = []
+            for b in range(B):
+                body = _rewrap(below, rebuild(gp.root, b))
+                if gp.agg is not None:
+                    body = partial_aggregate_node(
+                        gp.agg, body, partial_schema, partial_aggs,
+                        partial_names)
+                bf = self._make_fragment(body, frags, worker=placement[b],
+                                         kind="join", bucket=b)
+                bucket_scans.append(_frag_scan(bf))
+            if len(bucket_scans) == 1:
+                merged: L.LogicalPlan = bucket_scans[0]
+            else:
+                merged = L.Union(inputs=bucket_scans)
+                merged.schema = partial_schema if gp.agg is not None \
+                    else gp.root.schema
+            root = final_merge_plan(gp.agg, merged, final_plan) \
+                if gp.agg is not None else merged
+            root = _rewrap(above, root)
+        tracing.counter("grace.remote_partitions", B)
+        self.grace_info = {
+            "buckets": B, "partitioned_leaves": len(part),
+            "replicated_leaves": len(rep),
+            "budget_bytes": int(self.budget_bytes)}
+        if self.adaptive_enabled:
+            self.adaptive_info.append({
+                "strategy": "grace_distributed", "buckets": B,
+                "partitioned_leaves": len(part),
+                "adaptive_source": "estimated"})
+        return root
 
     # --- adaptive decisions (docs/adaptive.md) ---
 

@@ -249,6 +249,10 @@ DISPATCH = Message("dispatch", [
     Field("timeout_s", float,
           doc="query budget remaining, RELATIVE (clocks differ)"),
     Field("trace", dict, doc="trace_ctx block, when tracing"),
+    Field("budget", int,
+          doc="per-worker out-of-core byte budget (oversized queries only): "
+              "Exchange fragments stream-spill under it, join fragments run "
+              "residual-skew GRACE under it (docs/out_of_core.md)"),
 ], doc="coordinator -> worker execute_fragment action")
 
 #: registration/heartbeat payload. Version tolerance is the point: a worker
@@ -315,6 +319,9 @@ LAST_METRICS = Message("last_metrics", [
     Field("total_rows", int), Field("rows", int),
     Field("exchange_bytes", int), Field("execution_time_s", float),
     Field("result_cache_hit", bool),
+    Field("oversized", dict,
+          doc="distributed out-of-core block: {budget_bytes, buckets, "
+              "partitioned_leaves, replicated_leaves} (docs/out_of_core.md)"),
 ], check="schema", fill=False, doc="coordinator last_metrics action reply")
 
 #: serving_status action reply (docs/serving.md).

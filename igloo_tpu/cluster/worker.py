@@ -41,8 +41,10 @@ import pyarrow.flight as flight
 
 from igloo_tpu.catalog import Catalog, MemTable
 from igloo_tpu.cluster import exchange, faults, protocol, serde
-from igloo_tpu.cluster.fragment import FRAG_PREFIX, _frag_refs
+from igloo_tpu.cluster.fragment import (FRAG_PREFIX, _frag_refs,
+                                        _subtree_scan, _with_partition)
 from igloo_tpu.exec import encoded
+from igloo_tpu.storage import prefetch as _prefetch
 from igloo_tpu.cluster import rpc
 from igloo_tpu.cluster.rpc import flight_action, flight_stream_batches
 from igloo_tpu.cluster.rpc import normalize as _normalize
@@ -246,11 +248,15 @@ class WorkerServer(flight.FlightServerBase):
         return table
 
     def _execute_fragment(self, frag_id: str, plan_json: dict,
-                          addr_of: dict, deadline: Optional[float]) -> dict:
+                          addr_of: dict, deadline: Optional[float],
+                          budget: Optional[int] = None) -> dict:
         """Execute one deserialized dispatch (protocol fields already parsed
         out by `_handle_execute_fragment` — this method is wire-format-free):
         resolve dependencies, run the plan, store the result, and return the
-        fragment_stats report."""
+        fragment_stats report. A dispatch carrying `budget` is part of an
+        OVERSIZED query (docs/out_of_core.md): Exchange fragments stream
+        their scan piece-wise into per-bucket spill segments, and join
+        fragments get the worker-local GRACE ladder for residual skew."""
         overlay: dict = {}
         input_rows = 0
         # per-fragment counter delta: thread-isolated, so concurrent
@@ -281,15 +287,24 @@ class WorkerServer(flight.FlightServerBase):
                     salt = (plan.salt_bucket, plan.salt, plan.salt_role)
                 plan = plan.input
             t0 = time.perf_counter()
-            with tracing.span("fragment.execute") as sp:
-                ex = self._executor(plan)
-                table = ex.execute_to_arrow(plan)
-                sp.attrs = {"rows": table.num_rows,
-                            "mesh_devices": int(getattr(ex, "n_dev", 1))}
-            elapsed = time.perf_counter() - t0
-            with tracing.span("fragment.store"):
-                ent = self._store.put(frag_id, table, partition=partition,
-                                      salt=salt)
+            streamed = None
+            if partition is not None and budget:
+                streamed = self._try_stream_exchange(
+                    frag_id, plan, partition, salt, budget, deadline)
+            if streamed is not None:
+                ex, ent, nrows = streamed
+                elapsed = time.perf_counter() - t0
+            else:
+                with tracing.span("fragment.execute") as sp:
+                    ex = self._executor(plan)
+                    table = self._run_plan(ex, plan, catalog, budget)
+                    sp.attrs = {"rows": table.num_rows,
+                                "mesh_devices": int(getattr(ex, "n_dev", 1))}
+                nrows = table.num_rows
+                elapsed = time.perf_counter() - t0
+                with tracing.span("fragment.store"):
+                    ent = self._store.put(frag_id, table,
+                                          partition=partition, salt=salt)
         tracing.counter("worker.fragments")
         # local mesh-tier attribution: how many chips this fragment ran
         # across (1 = single-device) and its result rows per chip — the
@@ -302,14 +317,19 @@ class WorkerServer(flight.FlightServerBase):
         # are omitted on the wire — consumers read sparsely); result_bytes is
         # the Arrow size of the stored result, which the coordinator's
         # adaptive recording sums per join side
+        # a streamed (spilled) entry keeps only the resident tail in
+        # `nbytes`; its true result size is the per-bucket meta sum
+        result_bytes = ent.nbytes
+        if getattr(ent, "bucket_files", None):
+            result_bytes = sum(int(m.get("bytes", 0)) for m in ent.meta or [])
         out = protocol.FRAGMENT_STATS.build(
-            id=frag_id, rows=table.num_rows,
+            id=frag_id, rows=nrows,
             elapsed_s=round(elapsed, 6), worker=self.worker_id,
             dep_fetch_s=round(dep_s, 6),
             input_rows=input_rows,
             mesh_devices=mesh_devices,
-            mesh_rows_per_device=table.num_rows // mesh_devices,
-            result_bytes=ent.nbytes,
+            mesh_rows_per_device=nrows // mesh_devices,
+            result_bytes=result_bytes,
             h2d_bytes=delta.get("xfer.h2d_bytes"),
             d2h_bytes=delta.get("xfer.d2h_bytes"),
             jit_misses=delta.get("jit.miss"),
@@ -324,6 +344,75 @@ class WorkerServer(flight.FlightServerBase):
             if salt is not None:
                 out["salted"] = True
         return out
+
+    def _try_stream_exchange(self, frag_id: str, plan, partition, salt,
+                             budget: int, deadline: Optional[float]):
+        """Streaming exchange under the out-of-core budget: instead of
+        materializing the fragment's whole result and partitioning at store
+        time (the classic path builds the full input in RAM first), execute
+        the scan subtree ONE provider partition at a time — each piece fed
+        by the storage prefetcher — and hash-route it straight into the
+        store's per-bucket spill segments (cluster/exchange.py StreamingPut).
+        Returns (executor, stored entry, rows) or None when the input has no
+        multi-partition scan to stride, in which case the classic path runs
+        unchanged."""
+        sc = _subtree_scan(plan)
+        if sc is None or sc.provider is None:
+            return None
+        if sc.partition:
+            indices = [int(i) for i in sc.partition]
+        else:
+            try:
+                indices = list(range(sc.provider.num_partitions()))
+            except Exception:
+                return None
+        if len(indices) <= 1:
+            return None
+        keys, nbuckets = partition
+        ex = self._executor(plan)
+        handle = self._store.stream_put(frag_id, list(keys), nbuckets,
+                                        salt=salt, budget_bytes=budget)
+        items = [(sc.provider, i, sc.projection, sc.pushed_filters)
+                 for i in indices]
+        rows = 0
+        try:
+            with tracing.span("exchange.stream", frag=frag_id,
+                              pieces=len(indices), buckets=nbuckets) as sp, \
+                    _prefetch.scan_prefetch(items, deadline=deadline):
+                for i in indices:
+                    piece = _with_partition(plan, (i,))
+                    t = ex.execute_to_arrow(piece)
+                    rows += t.num_rows
+                    handle.append(t)
+                with tracing.span("fragment.store"):
+                    ent = handle.finish()
+                sp.attrs.update(rows=rows)
+        except Exception:
+            handle.abort()
+            raise
+        return ex, ent, rows
+
+    def _run_plan(self, ex, plan, catalog, budget: Optional[int]):
+        """Run one fragment plan, with the worker-local out-of-core ladder
+        in front when the dispatch carries a budget: the planner's buckets
+        are budget-sized by construction, so a join fragment whose inputs
+        STILL exceed the per-worker budget (residual skew — one hot key
+        class) recurses through the single-node GRACE loop locally instead
+        of OOMing. Mesh-sharded fragments skip the ladder — row-sharding
+        already bounds per-chip bytes."""
+        if budget and int(getattr(ex, "n_dev", 1)) <= 1 and \
+                any(isinstance(n, L.Join) for n in L.walk_plan(plan)):
+            from igloo_tpu.exec.grace import (GraceJoinExecutor,
+                                              find_grace_join)
+            found = find_grace_join(plan, budget)
+            if found is not None:
+                tracing.counter("engine.grace_route")
+                gx = GraceJoinExecutor(catalog, self._jit_cache,
+                                       use_jit=self._use_jit,
+                                       batch_cache=self._batch_cache,
+                                       budget_bytes=budget)
+                return gx.execute_to_arrow(plan, found)
+        return ex.execute_to_arrow(plan)
 
     # --- Flight surface ---
 
@@ -381,7 +470,8 @@ class WorkerServer(flight.FlightServerBase):
                               time.perf_counter() - t0)
             try:
                 out = self._execute_fragment(frag_id, disp["plan"], addr_of,
-                                             deadline)
+                                             deadline,
+                                             budget=disp["budget"])
             except IglooError as ex:
                 raise flight.FlightServerError(f"fragment failed: {ex}")
             finally:

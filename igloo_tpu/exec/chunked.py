@@ -120,6 +120,11 @@ class LocalChunkExecutor:
 
         planner = DistributedPlanner(
             [f"__chunk{i}" for i in range(self.chunks)])
+        # chunk slots are not workers: Exchange-rooted shuffle fragments
+        # need the worker fragment store + bucket fetch protocol, which the
+        # in-process Executor below does not speak — plain partitioned scan
+        # fragments only
+        planner.shuffle_enabled = False
         frags = planner.plan(plan)
 
         results: dict[str, pa.Table] = {}

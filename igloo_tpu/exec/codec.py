@@ -427,6 +427,15 @@ def record_carrier_ratio(provider, narrow_bytes: int,
         pass  # non-weakref-able provider: price wide, never crash
 
 
+def reset_carrier_ratios() -> None:
+    """Forget every measured ratio — restores the price-wide-until-measured
+    cold state. For tests and A/B bench runs that need plan pricing (and so
+    chunked/GRACE/admission routing) independent of which queries ran
+    earlier in the process."""
+    with _RATIO_LOCK:
+        _CARRIER_RATIOS.clear()
+
+
 def carrier_ratio(provider) -> float:
     """Measured carrier/wide byte ratio for this provider instance, or 1.0
     when unmeasured (or the kill switch is off)."""

@@ -89,21 +89,8 @@ def read_scan_table(plan: L.Scan, projection=_SENTINEL) -> pa.Table:
                 f"partition index for {plan.table} changed since planning "
                 "(source files moved/replaced)", table=plan.table)
     from igloo_tpu.storage import prefetch as _prefetch
-    pf = _prefetch.current()
-    parts = []
-    for i in plan.partition:
-        t = pf.take(plan.provider, i, plan.pushed_filters) \
-            if pf is not None else None
-        if t is not None and proj is not None:
-            try:
-                # prefetched at the scan's planned projection; narrow here
-                t = t.select(proj)
-            except KeyError:
-                t = None   # projection drifted: fall back to a sync read
-        if t is None:
-            t = plan.provider.read_partition(i, projection=proj,
-                                             filters=plan.pushed_filters)
-        parts.append(t)
+    parts = [t for _, t in _prefetch.take_partitioned(
+        plan.provider, plan.partition, proj, plan.pushed_filters)]
     return pa.concat_tables(parts) if parts else \
         plan.provider.read(projection=proj,
                            filters=plan.pushed_filters).slice(0, 0)

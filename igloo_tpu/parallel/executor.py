@@ -699,11 +699,20 @@ class ShardedExecutor(Executor):
         right = self._exec(plan.right)
         jt = plan.join_type
         n = self.n_dev
-        if (n <= 1 or jt is JoinType.CROSS or not plan.left_keys
-                or not self._speculate):
-            # cross / keyless / exact-mode joins run on gathered batches with
-            # the single-device kernel (exact mode needs the per-join count
-            # sync, which has no sharded counterpart yet)
+        if n <= 1 or jt is JoinType.CROSS or not plan.left_keys:
+            # cross / keyless joins run on gathered batches with the
+            # single-device kernel
+            return self._join_gathered(plan, left, right)
+        if not self._speculate:
+            if jt in (JoinType.INNER, JoinType.LEFT, JoinType.SEMI,
+                      JoinType.ANTI):
+                # exact mode (the overflow re-run): two-pass broadcast-build
+                # join sharded over the local devices — the count sync exact
+                # mode needs becomes one per-shard-max host sync instead of
+                # a gather of both sides to one device
+                return self._exact_join_sharded(plan, left, right)
+            # RIGHT/FULL emit unmatched BUILD rows, which a replicated build
+            # side would duplicate n times — those keep the gathered re-run
             return self._join_gathered(plan, left, right)
         left = left if is_row_sharded(left) else shard_rows(left, self.mesh)
         right = right if is_row_sharded(right) else shard_rows(right, self.mesh)
@@ -812,6 +821,80 @@ class ShardedExecutor(Executor):
             out_specs=(P(ROWS), P()), n_batch_args=2)(
             strip_dicts(left), strip_dicts(right), consts)
         self._deferred_overflow.append((("overflow", None), overflow))
+        if jt in (JoinType.SEMI, JoinType.ANTI):
+            dicts = [c.dictionary for c in left.columns]
+        else:
+            dicts = [c.dictionary for c in left.columns] + \
+                [c.dictionary for c in right.columns]
+        return attach_dicts(out, dicts[: len(out.columns)])
+
+    def _exact_join_sharded(self, plan: L.Join, left: DeviceBatch,
+                            right: DeviceBatch) -> DeviceBatch:
+        """Exact-mode keyed join WITHOUT gathering to one device: the probe
+        side stays row-sharded and the build side is replicated per shard
+        inside the program (the broadcast-join shape — strictly less memory
+        than `_join_gathered`, which replicates BOTH sides). Pass 1 probes
+        only and syncs the max per-shard candidate count to the host, which
+        picks the exact static match capacity (`choose_match_capacity`, the
+        same one-sync protocol as the single-device exact join); pass 2
+        re-probes and expands under it. The probe runs twice, but each pass
+        touches 1/n of the probe rows per chip and the output capacity is
+        exact — no overflow flag, no re-run, no gather cliff."""
+        from igloo_tpu.exec.join import choose_match_capacity
+        jt = plan.join_type
+        n = self.n_dev
+        left = left if is_row_sharded(left) else shard_rows(left, self.mesh)
+        right = right if is_row_sharded(right) else shard_rows(right,
+                                                               self.mesh)
+        pool = ConstPool()
+        compL = ExprCompiler([c.dictionary for c in left.columns], pool)
+        lres, lk, _ = self._compile_exprs(plan.left_keys, left, compL)
+        compR = ExprCompiler([c.dictionary for c in right.columns], pool)
+        rres, rk, _ = self._compile_exprs(plan.right_keys, right, compR)
+        lhx = make_key_hash_idxs(lk, pool)
+        rhx = make_key_hash_idxs(rk, pool)
+        residual = None
+        rres2 = []
+        marks = tuple(compL.marks) + tuple(compR.marks)
+        if plan.residual is not None:
+            compB = ExprCompiler([c.dictionary for c in left.columns] +
+                                 [c.dictionary for c in right.columns], pool)
+            r = self._resolve_subqueries(plan.residual)
+            rres2 = [r]
+            residual = compB.compile(r)
+            marks = marks + tuple(compB.marks)
+        consts = pool.device_args()
+        fpbase = ("xjoin", expr_fingerprint(lres + rres + rres2), jt,
+                  batch_proto_key(left), batch_proto_key(right),
+                  pool.signature(), marks, n, plan.schema)
+
+        def count_fn(l, r, consts):
+            r2 = broadcast_batch_local(r, ROWS)
+            p = probe_phase(l, r2, lk, rk, lhx, rhx, consts)
+            return jax.lax.pmax(p.total, ROWS)
+
+        total = int(self._jitted_shard_map(
+            "xjoin_count", fpbase + ("count",), count_fn,
+            out_specs=P(), n_batch_args=2)(
+            strip_dicts(left), strip_dicts(right), consts))  # the one sync
+        match_cap = choose_match_capacity(total)
+
+        def expand_fn(l, r, consts):
+            r2 = broadcast_batch_local(r, ROWS)
+            p = probe_phase(l, r2, lk, rk, lhx, rhx, consts)
+            # returned as-is: capacity is match_cap (INNER), probe capacity
+            # (SEMI/ANTI), or their sum (LEFT) — uniform across shards, and
+            # SEMI/ANTI live counts routinely exceed match_cap (which bounds
+            # MATCHED candidates), so resizing down would drop rows
+            return expand_phase(l, r2, p, match_cap, jt, residual,
+                                plan.schema, consts)
+
+        out = self._jitted_shard_map(
+            "xjoin", fpbase + (match_cap,), expand_fn,
+            out_specs=P(ROWS), n_batch_args=2)(
+            strip_dicts(left), strip_dicts(right), consts)
+        tracing.counter("join.exact_sharded")
+        stats.annotate(strategy="exact_sharded")
         if jt in (JoinType.SEMI, JoinType.ANTI):
             dicts = [c.dictionary for c in left.columns]
         else:

@@ -81,6 +81,31 @@ def current() -> Optional["ScanPrefetcher"]:
     return getattr(_tls, "prefetcher", None)
 
 
+def take_partitioned(provider, indices, projection, filters):
+    """Per-partition iterator over (index, table): each partition is served
+    off the installed prefetcher when one is live on this thread (the
+    hit/wait/steal semantics of `take`) and read synchronously otherwise.
+    `read_scan_table` concats this iterator; the STREAMING exchange
+    partitioner (cluster/worker.py) instead hash-routes each yielded row
+    group straight into per-bucket spill files, so the full-table assembly
+    never happens on that path. With `IGLOO_STORAGE_PREFETCH=0` no
+    prefetcher is ever installed and every partition is one synchronous
+    `read_partition` — bit-identical to the pre-prefetch loop."""
+    pf = current()
+    for i in indices:
+        t = pf.take(provider, int(i), filters) if pf is not None else None
+        if t is not None and projection is not None:
+            try:
+                # prefetched at the scan's planned projection; narrow here
+                t = t.select(projection)
+            except KeyError:
+                t = None   # projection drifted: fall back to a sync read
+        if t is None:
+            t = provider.read_partition(int(i), projection=projection,
+                                        filters=filters)
+        yield int(i), t
+
+
 def _filter_fp(filters) -> str:
     return "|".join(repr(e) for e in filters) if filters else ""
 

@@ -58,6 +58,12 @@ WATCH_COUNTERS = (
     "pallas.probe_overflow",
     "pallas.agg_overflow",
     "exchange.spills",
+    # distributed out-of-core (docs/out_of_core.md): spill volume growing
+    # means the streaming exchange holds less resident per bucket, remote
+    # partition count growing means the planner fans joins wider — both
+    # explain wall-time drift under a byte budget
+    "exchange.spill_bytes",
+    "grace.remote_partitions",
     # compressed execution (docs/compressed_execution.md): carrier bytes
     # growing toward decoded bytes, or H2D bytes growing at all, means
     # columns stopped riding narrow carriers — a silent de-compression is
@@ -158,6 +164,21 @@ def compare(base: dict, cand: dict, warm_tol: float, abs_slack: float,
         else:
             notes.append(f"{q}: warm {cw:.4f}s vs baseline {bw:.4f}s "
                          f"({cw / bw:.2f}x) ok")
+        co = c.get("oversized")
+        if isinstance(co, dict):
+            # memory-scaled mode (bench.py --hbm-budget): completing under
+            # the byte budget is the gate; throughput-under-budget drifts
+            # within the same warm tolerance as wall time
+            if not co.get("completed", False):
+                failures.append(f"{q}: did not complete under hbm budget "
+                                f"{co.get('budget_bytes')}")
+            bo = b.get("oversized") or {}
+            brps = bo.get("rows_per_s_under_budget")
+            crps = co.get("rows_per_s_under_budget")
+            if brps and crps and crps * warm_tol < brps:
+                failures.append(
+                    f"{q}: rows/s under budget {crps} fell below baseline "
+                    f"{brps} / x{warm_tol}")
         bc, cc = b.get("counters") or {}, c.get("counters") or {}
         for key in WATCH_COUNTERS:
             if key not in bc or key not in cc:
@@ -182,7 +203,7 @@ def write_baseline(src: str, dst: str) -> None:
         "counter_tol": DEFAULT_COUNTER_TOL,
         "queries": {q: {k: v for k, v in rec.items()
                         if k in ("warm_med_s", "cold_s", "rows_per_s",
-                                 "counters", "grace", "packed")}
+                                 "counters", "grace", "packed", "oversized")}
                     for q, rec in sorted(qs.items())},
     }
     with open(dst, "w") as f:

@@ -14,6 +14,7 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ["IGLOO_TPU_COMPILE_CACHE"] = "0"
 os.environ["IGLOO_SERVING_RESULT_CACHE"] = "0"

@@ -35,6 +35,9 @@ python scripts/twolevel_smoke.py
 echo "== chaos smoke (injected faults + worker kill + hung worker) =="
 python scripts/chaos_smoke.py
 
+echo "== out-of-core smoke (2-worker GRACE buckets: spill-and-stream under budget) =="
+python scripts/oocore_smoke.py
+
 echo "== storage smoke (fault-injected object store: retries + snapshot re-plan + bounded prefetch) =="
 python scripts/storage_smoke.py
 

@@ -333,7 +333,7 @@ def _slot_worker(slots: int):
     state = {"cur": 0, "peak": 0}
     lock = threading.Lock()
 
-    def fake_fragment(frag_id, plan_json, addr_of, deadline):
+    def fake_fragment(frag_id, plan_json, addr_of, deadline, budget=None):
         with lock:
             state["cur"] += 1
             state["peak"] = max(state["peak"], state["cur"])
