@@ -90,7 +90,101 @@ def warm_cache(sf: float) -> int:
     return 0
 
 
+def render_top(status: dict, coordinator: str = "") -> str:
+    """Render one `watch_status` snapshot (cluster/protocol.py
+    WATCH_STATUS) as the `igloo top` screen. Pure — testable without a
+    cluster (docs/observability.md#watchtower)."""
+    import time
+    out = []
+    hdr = "igloo top"
+    if coordinator:
+        hdr += f" — {coordinator}"
+    out.append(hdr)
+    out.append(f"queries   qps {status.get('qps') or 0.0:g}   "
+               f"p50 {status.get('p50_ms') or 0.0:g} ms   "
+               f"p99 {status.get('p99_ms') or 0.0:g} ms   "
+               f"(window {status.get('window_s') or 0.0:g}s)")
+    serving = status.get("serving") or {}
+    if serving:
+        out.append("serving   " + "   ".join(
+            f"{k} {serving[k]}" for k in sorted(serving)))
+    workers = status.get("workers") or []
+    out.append(f"workers ({len(workers)})")
+    for w in workers:
+        out.append(f"  {str(w.get('id', '?')):<14} "
+                   f"{str(w.get('addr', '')):<24} "
+                   f"devices {w.get('devices', 1):<3} "
+                   f"slots {w.get('slots', 0):<3} "
+                   f"age {w.get('age_s') or 0.0:g}s")
+    samples = status.get("samples") or []
+    if samples:
+        # memory pressure from the newest sampler row's byte-sized gauges
+        gauges = samples[-1].get("gauges") or {}
+        mem = [(k, v) for k, v in sorted(gauges.items())
+               if "hbm" in k or "bytes" in k]
+        if mem:
+            out.append("gauges    " + "   ".join(
+                f"{k} {v:g}" for k, v in mem[:6]))
+    active = status.get("active") or []
+    out.append(f"active queries ({len(active)})"
+               + (": " + ", ".join(str(q) for q in active)
+                  if active else ""))
+    out.append("recent events")
+    evs = status.get("events") or []
+    if not evs:
+        out.append("  (none)")
+    for ev in evs[-10:]:
+        ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts") or 0.0))
+        tags = [f"{k}={ev[k]}" for k in ("worker", "qid") if ev.get(k)]
+        tags += [f"{k}={v}" for k, v in sorted(
+            (ev.get("attrs") or {}).items())]
+        out.append(f"  {ts}  {str(ev.get('severity', 'info')).upper():<5} "
+                   f"{str(ev.get('kind', '?')):<22} " + " ".join(tags))
+    return "\n".join(out)
+
+
+def top_main(argv=None) -> int:
+    """`igloo top`: live cluster dashboard off the coordinator's one-call
+    `watch_status` action — qps/latency quantiles, admission state,
+    per-worker topology, active queries, the journal tail."""
+    ap = argparse.ArgumentParser(
+        prog="igloo top",
+        description="live cluster dashboard (watchtower snapshot)")
+    ap.add_argument("--coordinator", default="127.0.0.1:50051",
+                    help="coordinator address host:port")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen clear)")
+    args = ap.parse_args(argv)
+    import time
+    from igloo_tpu.cluster.client import DistributedClient
+    try:
+        client = DistributedClient(args.coordinator)
+        while True:
+            status = client.watch_status()
+            text = render_top(status, coordinator=args.coordinator)
+            if not args.once:
+                print("\x1b[2J\x1b[H", end="")
+            print(text, flush=True)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    except Exception as ex:
+        print(f"error: cannot reach coordinator at {args.coordinator}: {ex}",
+              file=sys.stderr)
+        return 2
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "top":
+        # subcommand, dispatched before the flag parser (the main surface
+        # stays flag-based for reference parity)
+        return top_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="igloo",
         description="igloo-tpu: TPU-native distributed SQL engine")

@@ -81,6 +81,33 @@ class DistributedClient:
         return self._action("poll_flight_info",
                             protocol.POLL_FLIGHT_INFO.build(sql=sql))
 
+    # --- watchtower (docs/observability.md#watchtower) ---
+
+    def metrics_history(self) -> list:
+        """The fleet's sampler rings, source-labeled and merged by
+        timestamp: the coordinator's own plus every live worker's."""
+        return protocol.METRICS_HISTORY.parse(
+            self._action("metrics_history"))["samples"]
+
+    def events(self, min_severity: str = "info",
+               limit: Optional[int] = None) -> list:
+        """Cluster event journal, oldest first, at or above
+        `min_severity` ("info" | "warn" | "error")."""
+        return protocol.EVENTS_REPLY.parse(self._action(
+            "events", protocol.EVENTS_REQUEST.build(
+                min_severity=min_severity, limit=limit)))["events"]
+
+    def slow_queries(self) -> list:
+        """Baseline-anomaly escalation records (system.slow_queries)."""
+        return protocol.SLOW_QUERIES_REPLY.parse(
+            self._action("slow_queries"))["slow_queries"]
+
+    def watch_status(self) -> dict:
+        """One-call ops snapshot behind `igloo top`: qps/latency
+        quantiles, admission state, workers, active queries, recent
+        journal events and sampler rows."""
+        return protocol.WATCH_STATUS.parse(self._action("watch_status"))
+
     # --- queries ---
 
     def execute(self, sql: str, deadline_s: Optional[float] = None,

@@ -37,14 +37,14 @@ from typing import Optional
 import pyarrow as pa
 import pyarrow.flight as flight
 
-from igloo_tpu.cluster import faults, protocol, rpc, serde, serving
+from igloo_tpu.cluster import events, faults, protocol, rpc, serde, serving
 from igloo_tpu.cluster.fragment import DistributedPlanner, QueryFragment
 from igloo_tpu.cluster.rpc import flight_action
 from igloo_tpu.engine import QueryEngine
 from igloo_tpu.errors import (
     DeadlineExceededError, IglooError, QueryCancelledError,
 )
-from igloo_tpu.utils import flight_recorder, stats, tracing
+from igloo_tpu.utils import flight_recorder, stats, timeseries, tracing, watch
 
 #: default per-query deadline (seconds) for the distributed path; unset or
 #: <= 0 = unbounded. Precedence: per-call override > this env var > [rpc]
@@ -121,15 +121,26 @@ class Membership:
     def __init__(self, timeout_s: float = 15.0):
         self.timeout_s = timeout_s
         self._workers: dict[str, WorkerState] = {}
+        # ids evicted at least once: a re-registration from one of these is
+        # a RECOVERY (journaled worker_recover, not worker_join)
+        self._evicted_ids: set = set()
         self._lock = threading.Lock()
 
     def register(self, worker_id: str, addr: str, devices: int = 1,
                  slots: int = 0) -> None:
         with self._lock:
+            rejoin = worker_id in self._evicted_ids
+            self._evicted_ids.discard(worker_id)
             self._workers[worker_id] = WorkerState(
                 worker_id, addr, time.time(),
                 devices=max(int(devices), 1), slots=int(slots))
         tracing.counter("coordinator.workers_registered")
+        if rejoin:
+            events.emit("worker_recover", worker=worker_id, addr=addr,
+                        devices=int(devices), slots=int(slots))
+        else:
+            events.emit("worker_join", worker=worker_id, addr=addr,
+                        devices=int(devices), slots=int(slots))
 
     def heartbeat(self, worker_id: str, addr: str = "",
                   devices: Optional[int] = None,
@@ -160,8 +171,13 @@ class Membership:
 
     def evict(self, worker_id: str) -> None:
         with self._lock:
-            self._workers.pop(worker_id, None)
+            known = self._workers.pop(worker_id, None) is not None
+            if known:
+                self._evicted_ids.add(worker_id)
         tracing.counter("coordinator.workers_evicted")
+        if known:
+            events.emit("worker_evict", severity="warn", worker=worker_id,
+                        reason="unreachable")
 
     def sweep(self) -> list[str]:
         """Evict workers silent for > timeout; returns evicted ids."""
@@ -171,8 +187,11 @@ class Membership:
                     if w.last_seen < cutoff]
             for wid in dead:
                 self._workers.pop(wid, None)
-        for _ in dead:
+                self._evicted_ids.add(wid)
+        for wid in dead:
             tracing.counter("coordinator.workers_evicted")
+            events.emit("worker_evict", severity="warn", worker=wid,
+                        reason="heartbeat_timeout")
         return dead
 
     def live(self) -> list[WorkerState]:
@@ -393,6 +412,8 @@ class DistributedExecutor:
                                 frags[fid].worker = others[i % len(others)]
                             tracing.counter(
                                 "coordinator.fragments_requeued_busy")
+                            events.emit("fragment_requeue_busy",
+                                        qid=qid, worker=addr, frag=fid)
                     for dep_id in lost_deps:
                         # the holder of this dep result is unreachable from a
                         # peer: treat it as dead and re-run the dep
@@ -542,6 +563,8 @@ class DistributedExecutor:
         if isinstance(error, QueryCancelledError) or metrics["cancelled"]:
             status = "cancelled"
             tracing.counter("query.cancelled")
+            events.emit("query_cancelled", severity="warn", qid=qid,
+                        trace_id=metrics.get("trace_id", ""))
         elif isinstance(error, DeadlineExceededError) or \
                 metrics["deadline_exceeded"]:
             # covers both the wave/relay checks and an rpc-layer
@@ -549,6 +572,9 @@ class DistributedExecutor:
             status = "deadline_exceeded"
             metrics["deadline_exceeded"] = True
             tracing.counter("query.deadline_exceeded")
+            events.emit("query_deadline", severity="warn", qid=qid,
+                        trace_id=metrics.get("trace_id", ""),
+                        deadline_s=metrics.get("deadline_s"))
         elif error is not None:
             status = "error"
         metrics["status"] = status
@@ -578,6 +604,31 @@ class DistributedExecutor:
                         priority=pub.get("priority", 1),
                         demoted=pub.get("demoted", 0),
                         trace_id=pub.get("trace_id", ""))
+        if status == "ok" and completed:
+            # watchtower baseline check: judged against this fingerprint's
+            # OWN history, then folded in (docs/observability.md#watchtower).
+            # After flight_recorder.publish above, so an escalation's pin()
+            # finds the trace already ring-resident.
+            watch.check_query(
+                metrics.get("_plan_fp"), pub["execution_time_s"],
+                exchange_bytes=float(pub.get("exchange_bytes") or 0),
+                qid=qid, trace_id=pub.get("trace_id", ""), sql=sql,
+                tier="distributed", phase=self._dominant_phase(pub))
+
+    @staticmethod
+    def _dominant_phase(pub: dict) -> str:
+        """Attribute a distributed query's wall time to its widest phase
+        (the slow-query record's `dominant_phase` column)."""
+        frags = pub.get("fragments") or []
+        buckets = {
+            "execute": sum(i.get("elapsed_s") or 0.0 for i in frags),
+            "dispatch": sum(i.get("dispatch_s") or 0.0 for i in frags),
+            "dep_fetch": sum(i.get("dep_fetch_s") or 0.0 for i in frags),
+            "fetch": pub.get("fetch_s") or 0.0,
+            "recover": pub.get("recover_s") or 0.0,
+        }
+        name = max(buckets, key=buckets.get)
+        return name if buckets[name] > 0 else ""
 
     def _record_adaptive(self, frag_infos: list) -> None:
         """Fold a finished query's per-fragment reports into the process-wide
@@ -763,10 +814,16 @@ class DistributedExecutor:
                 del completed[fid]
                 pending.add(fid)  # pure fragment: safe to re-run
         rr = itertools.cycle(live)
+        moved = 0
         for fid in pending:
             if frags[fid].worker not in live:
                 frags[fid].worker = next(rr)
                 tracing.counter("coordinator.fragments_redispatched")
+                moved += 1
+        if moved:
+            # one journal event per recovery round, not per fragment
+            events.emit("fragment_redispatch", severity="warn",
+                        fragments=moved, dead=sorted(dead_addrs))
 
     def _accumulate(self, metrics: dict) -> None:
         """Fold one query's per-fragment stats into the cumulative per-worker
@@ -896,6 +953,8 @@ class CoordinatorServer(flight.FlightServerBase):
         self._stop = threading.Event()
         self._sweeper = threading.Thread(target=self._sweep_loop, daemon=True)
         self._sweeper.start()
+        # watchtower sampler (utils/timeseries.py): no-op under IGLOO_WATCH=0
+        timeseries.start("coordinator")
 
     # --- table management ---
 
@@ -975,6 +1034,8 @@ class CoordinatorServer(flight.FlightServerBase):
             stats.log_query(sql, elapsed_s=time.time() - t_start,
                             tier="serving", status="shed",
                             started_at=t_start, priority=priority)
+            events.emit("admission_shed", severity="warn", qid=qid or "",
+                        priority=priority)
             raise
         try:
             out = self._execute_admitted(plan, sql, stream, deadline,
@@ -1035,6 +1096,12 @@ class CoordinatorServer(flight.FlightServerBase):
         # parallelism, docs/distributed.md)
         topo = {w.addr: w.devices for w in live}
         planner = DistributedPlanner([w.addr for w in live], topology=topo)
+        # watchtower baseline key, captured BEFORE fragmenting: the planner
+        # rewrites the tree in place (partial-agg Union merge has no stable
+        # key), and the baseline must describe the user's logical plan — the
+        # same key the local tier would observe under
+        from igloo_tpu.exec import hints
+        plan_key = hints.plan_fp(plan)
         frags = planner.plan(plan)
         tracing.counter("coordinator.distributed_queries")
         # reorder decisions from engine.plan's optimize() above ride beside
@@ -1043,6 +1110,9 @@ class CoordinatorServer(flight.FlightServerBase):
         adaptive_info = last_adaptive_decisions() + planner.adaptive_info
         extra = {"queue_wait_s": round(permit.wait_s, 6),
                  "priority": permit.priority, "demoted": 0,
+                 # "_"-prefixed: never published; _finalize judges the
+                 # finished query under it
+                 "_plan_fp": plan_key,
                  # the topology this query was planned against, published in
                  # last_metrics beside the per-fragment mesh_devices reports
                  "topology": {"workers": len(live),
@@ -1137,6 +1207,10 @@ class CoordinatorServer(flight.FlightServerBase):
         topo = {w.addr: w.devices for w in live}
         planner = DistributedPlanner([w.addr for w in live], topology=topo,
                                      budget_bytes=budget)
+        # captured before planner.plan rewrites the tree (see
+        # _run_distributed): the baseline keys the user's logical plan
+        from igloo_tpu.exec import hints
+        plan_key = hints.plan_fp(plan)
         try:
             frags = planner.plan(plan)
         except Exception:
@@ -1149,6 +1223,7 @@ class CoordinatorServer(flight.FlightServerBase):
         adaptive_info = last_adaptive_decisions() + planner.adaptive_info
         extra = {"queue_wait_s": round(permit.wait_s, 6),
                  "priority": permit.priority, "demoted": 0,
+                 "_plan_fp": plan_key,
                  # per-query out-of-core attribution, published in
                  # last_metrics and the sweep JSON `oversized` block
                  "oversized": dict(planner.grace_info),
@@ -1202,6 +1277,7 @@ class CoordinatorServer(flight.FlightServerBase):
         column; an OOM on the last rung surfaces."""
         self._check_local_deadline(deadline, sql, t_start, priority)
         tracing.counter("serving.demoted")
+        events.emit("query_demoted", severity="warn", rung=level)
         stats.mark_demoted()
         budget = self._demote_budget()
         if level <= 1:
@@ -1352,6 +1428,9 @@ class CoordinatorServer(flight.FlightServerBase):
                 info["id"], info["addr"],
                 devices=info["devices"] if "devices" in req else None,
                 slots=info["slots"])
+            # journal events riding the beat (cluster/events.py; dedup by
+            # eid keeps in-process fleets and heartbeat retries honest)
+            events.ingest(info["events"], worker=info["id"])
             return [json.dumps({"ok": ok}).encode()]
         if action.type == "register_table":
             rt = protocol.REGISTER_TABLE.parse(req)
@@ -1392,6 +1471,7 @@ class CoordinatorServer(flight.FlightServerBase):
                      "# TYPE igloo_cluster_devices gauge",
                      f"igloo_cluster_devices {sum(w.devices for w in live_w)}"]
             extra.extend(self.executor.prometheus_lines())
+            extra.extend(events.prometheus_lines())
             return [tracing.prometheus_text(extra_lines=extra).encode()]
         if action.type == "ping":
             return [json.dumps({"workers": len(self.membership.live())}).encode()]
@@ -1402,7 +1482,76 @@ class CoordinatorServer(flight.FlightServerBase):
                     protocol.POLL_FLIGHT_INFO.parse(req)["sql"]))
             return [json.dumps({"progress": 1.0, "complete": True}).encode(),
                     info.serialize()]
+        if action.type == "metrics_history":
+            return [json.dumps(protocol.METRICS_HISTORY.build(
+                samples=self._aggregate_metrics_history())).encode()]
+        if action.type == "events":
+            er = protocol.EVENTS_REQUEST.parse(req)
+            evs = events.events(min_severity=er["min_severity"] or "info",
+                                limit=er["limit"] if er["limit"] else None)
+            return [json.dumps(
+                protocol.EVENTS_REPLY.build(events=evs)).encode()]
+        if action.type == "slow_queries":
+            return [json.dumps(protocol.SLOW_QUERIES_REPLY.build(
+                slow_queries=watch.slow_queries())).encode()]
+        if action.type == "watch_status":
+            return [json.dumps(self._watch_status()).encode()]
         raise flight.FlightServerError(f"unknown action {action.type}")
+
+    def _aggregate_metrics_history(self) -> list:
+        """The fleet's sampler rings: this process's own plus every live
+        worker's (fetched via its `metrics_history` action, relabeled with
+        the worker id), merged by timestamp. A worker that cannot answer is
+        skipped — a telemetry read must never fail on a flaky fleet. Dedup
+        by sample id: an in-process fleet shares one ring, and its samples
+        must not triple-count."""
+        samples = list(timeseries.samples())
+        seen = {s.get("sid") for s in samples}
+        for w in self.membership.live():
+            try:
+                resp = flight_action(w.addr, "metrics_history", {},
+                                     timeout_s=10.0)
+                for s in protocol.METRICS_HISTORY.parse(resp)["samples"]:
+                    if s.get("sid") in seen:
+                        continue
+                    seen.add(s.get("sid"))
+                    s = dict(s)
+                    s["source"] = f"worker:{w.worker_id}"
+                    samples.append(s)
+            except Exception:
+                pass
+        samples.sort(key=lambda s: s.get("ts", 0.0))
+        return samples
+
+    def _watch_status(self) -> dict:
+        """The one-call ops snapshot behind `igloo top`: throughput and
+        latency quantiles over the recent query log, admission state,
+        per-worker topology, in-flight qids, and the journal tail."""
+        now = time.time()
+        window_s = 60.0
+        recent = [q.to_record() for q in stats.query_log()
+                  if now - q.started_at <= window_s]
+        lats = sorted(r["elapsed_s"] for r in recent)
+
+        def pct(q: float) -> float:
+            if not lats:
+                return 0.0
+            return lats[min(max(int(q * len(lats) + 0.999999) - 1, 0),
+                            len(lats) - 1)]
+
+        return protocol.WATCH_STATUS.build(
+            qps=round(len(recent) / window_s, 4),
+            p50_ms=round(pct(0.5) * 1000.0, 3),
+            p99_ms=round(pct(0.99) * 1000.0, 3),
+            window_s=window_s,
+            serving=self.admission.snapshot(),
+            workers=[{"id": w.worker_id, "addr": w.addr,
+                      "devices": w.devices, "slots": w.slots,
+                      "age_s": round(now - w.last_seen, 1)}
+                     for w in self.membership.live()],
+            active=self.executor.active_queries(),
+            events=events.events(limit=20),
+            samples=timeseries.samples()[-12:])
 
     def list_actions(self, context):
         # straight from the registry: the flight-actions checker holds this

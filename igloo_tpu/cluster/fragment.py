@@ -653,6 +653,9 @@ class DistributedPlanner:
                 "max_share": round(float(obs["max_share"]), 4),
                 "adaptive_source": "observed"})
             tracing.counter("adaptive.salted")
+            from igloo_tpu.cluster import events
+            events.emit("exchange_salted", hot_bucket=int(obs["hot_bucket"]),
+                        salt=S, max_share=round(float(obs["max_share"]), 4))
             return int(obs["hot_bucket"]), S, probe_left
         return None
 
@@ -670,6 +673,9 @@ class DistributedPlanner:
         probe_frags = self._side_fragments(
             probe, frags, stats_key=rkey if build_left else lkey)
         tracing.counter("adaptive.broadcast")
+        from igloo_tpu.cluster import events
+        events.emit("broadcast_join", build=build_side,
+                    probe_fragments=len(probe_frags))
         self.adaptive_info.append({
             "strategy": "broadcast", "build": build_side,
             "probe_fragments": len(probe_frags),

@@ -268,6 +268,11 @@ WORKER_INFO = Message("worker_info", [
     Field("devices", int, default=1,
           doc="local mesh size one fragment runs across"),
     Field("slots", int, default=0, doc="execution-slot bound"),
+    Field("events", list, default=[],
+          doc="watchtower journal events since the last heartbeat "
+              "(cluster/events.py drain_forward; coordinator ingests them "
+              "under this worker's label — absent from pre-watchtower "
+              "workers, which is the empty batch)"),
 ], doc="worker -> coordinator register_worker/heartbeat actions")
 
 #: per-fragment stats the worker returns from execute_fragment — the shape
@@ -368,6 +373,45 @@ POLL_FLIGHT_INFO = Message("poll_flight_info", [
     Field("sql", str, required=True),
 ], doc="client -> coordinator poll_flight_info action")
 
+# --- watchtower payloads (docs/observability.md#watchtower) -----------------
+
+EVENTS_REQUEST = Message("events_request", [
+    Field("min_severity", str, default="info", doc="info | warn | error"),
+    Field("limit", int, doc="most-recent-N cap (None = whole ring)"),
+], doc="client -> coordinator events action")
+
+#: metrics_history reply: the coordinator's own sampler ring plus every
+#: live worker's, each sample labeled by its `source` field ("coordinator"
+#: or the worker id).
+METRICS_HISTORY = Message("metrics_history", [
+    Field("samples", list, required=True,
+          doc="sample dicts {ts, source, rates, gauges}, oldest first"),
+], check="schema", fill=False,
+    doc="coordinator/worker metrics_history action reply")
+
+EVENTS_REPLY = Message("events_reply", [
+    Field("events", list, required=True,
+          doc="journal event dicts, oldest first"),
+], check="schema", fill=False, doc="coordinator events action reply")
+
+SLOW_QUERIES_REPLY = Message("slow_queries_reply", [
+    Field("slow_queries", list, required=True,
+          doc="escalation records, oldest first (utils/watch.py)"),
+], check="schema", fill=False, doc="coordinator slow_queries action reply")
+
+#: one-call ops snapshot behind `igloo top`.
+WATCH_STATUS = Message("watch_status", [
+    Field("qps", float, doc="completions/s over the recent log window"),
+    Field("p50_ms", float), Field("p99_ms", float),
+    Field("window_s", float, doc="the qps/quantile window width"),
+    Field("serving", dict, doc="{running, queued, hbm_reserved_bytes}"),
+    Field("workers", list,
+          doc="per-worker {id, addr, devices, slots, age_s}"),
+    Field("active", list, doc="in-flight qids"),
+    Field("events", list, doc="most recent journal events"),
+    Field("samples", list, doc="most recent sampler rows"),
+], check="schema", fill=False, doc="coordinator watch_status action reply")
+
 
 # --- Flight action-name tables ----------------------------------------------
 # The flight-actions checker cross-checks each server's do_action dispatch
@@ -395,6 +439,12 @@ COORDINATOR_ACTIONS = {
     "poll_flight_info": "PollFlightInfo equivalent: serialized FlightInfo "
                         "for a SQL command, progress=1.0 (planning "
                         "completes eagerly)",
+    "metrics_history": "watchtower sampler rings, coordinator + live "
+                       "workers, source-labeled",
+    "events": "cluster event journal (min_severity/limit filters)",
+    "slow_queries": "baseline-anomaly escalation records",
+    "watch_status": "one-call ops snapshot: qps/latency quantiles, "
+                    "workers, active queries, recent events (igloo top)",
 }
 
 WORKER_ACTIONS = {
@@ -403,6 +453,7 @@ WORKER_ACTIONS = {
     "release": "drop cached fragment results",
     "ping": "liveness + status",
     "metrics": "process metrics, Prometheus text format",
+    "metrics_history": "this worker's watchtower sampler ring",
 }
 
 #: which module serves which action table (the flight-actions checker reads

@@ -306,15 +306,22 @@ def _rx(j, catalog) -> Optional[E.Expr]:
 
 
 def worker_info_to_json(worker_id: str, addr: str, devices: int = 1,
-                        slots: int = 0) -> dict:
+                        slots: int = 0, events: Optional[list] = None) -> dict:
     """The registration/heartbeat payload, built through the protocol
     registry (cluster/protocol.py WORKER_INFO) so both sides of the wire
     share one declaration: `devices` is the size of the worker's LOCAL mesh
     (1 = single-device) — the topology number the distributed planner sizes
     bucket counts and placement with (bucket count scales with hosts, shard
     count with chips, docs/distributed.md) — and `slots` its execution-slot
-    bound. (The pre-PR14 heartbeat also shipped a wall-clock `ts` no
-    consumer ever read; the wire-contract checker retired it.)"""
+    bound. `events` is the watchtower journal batch riding the heartbeat
+    (cluster/events.drain_forward; omitted when empty so registration and
+    legacy payloads stay byte-identical). (The pre-PR14 heartbeat also
+    shipped a wall-clock `ts` no consumer ever read; the wire-contract
+    checker retired it.)"""
+    if events:
+        return protocol.WORKER_INFO.build(id=worker_id, addr=addr,
+                                          devices=int(max(devices, 1)),
+                                          slots=int(slots), events=events)
     return protocol.WORKER_INFO.build(id=worker_id, addr=addr,
                                       devices=int(max(devices, 1)),
                                       slots=int(slots))
@@ -328,7 +335,8 @@ def worker_info_from_json(d: dict) -> dict:
     info = protocol.WORKER_INFO.parse(d)
     return {"id": info["id"], "addr": info["addr"],
             "devices": int(info["devices"] or 1),
-            "slots": int(info["slots"] or 0)}
+            "slots": int(info["slots"] or 0),
+            "events": list(info["events"] or [])}
 
 
 # --- provider specs (how a worker re-creates a coordinator table) ---

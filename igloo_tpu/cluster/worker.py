@@ -40,7 +40,7 @@ import pyarrow as pa
 import pyarrow.flight as flight
 
 from igloo_tpu.catalog import Catalog, MemTable
-from igloo_tpu.cluster import exchange, faults, protocol, serde
+from igloo_tpu.cluster import events, exchange, faults, protocol, serde
 from igloo_tpu.cluster.fragment import (FRAG_PREFIX, _frag_refs,
                                         _subtree_scan, _with_partition)
 from igloo_tpu.exec import encoded
@@ -50,7 +50,7 @@ from igloo_tpu.cluster.rpc import flight_action, flight_stream_batches
 from igloo_tpu.cluster.rpc import normalize as _normalize
 from igloo_tpu.errors import IglooError
 from igloo_tpu.plan import logical as L
-from igloo_tpu.utils import flight_recorder, tracing
+from igloo_tpu.utils import flight_recorder, timeseries, tracing
 
 
 # lock discipline (checked by igloo-lint lock-discipline): Flight serves
@@ -516,6 +516,11 @@ class WorkerServer(flight.FlightServerBase):
             # Prometheus text exposition of this worker process's registry
             # (raw bytes, not JSON — scrape via rpc.flight_action_raw)
             return [tracing.prometheus_text().encode()]
+        if action.type == "metrics_history":
+            # this process's watchtower sampler ring; the coordinator's
+            # metrics_history action aggregates these across the fleet
+            return [json.dumps(protocol.METRICS_HISTORY.build(
+                samples=timeseries.samples())).encode()]
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
@@ -593,6 +598,7 @@ class Worker:
         return self.server.advertise
 
     def start(self) -> None:
+        timeseries.start("worker")
         self._register()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
@@ -679,6 +685,7 @@ class Worker:
     def _prewarm_pull(self, missing: list) -> None:
         from igloo_tpu import compile_cache
         done = 0
+        pulled = 0
         try:
             # one connection for the whole pre-warm (rpc.flight_actions_raw):
             # a connect/teardown per entry would dominate the transfer
@@ -690,6 +697,7 @@ class Worker:
                 done += 1
                 if data and compile_cache.write_entry(name, data):
                     tracing.counter("compile_cache.pull")
+                    pulled += 1
         except Exception:
             # the batch connection died — usually ONE entry past the
             # transport's message cap. Finish per-entry so everything after
@@ -702,8 +710,13 @@ class Worker:
                         protocol.COMPILE_CACHE_GET.build(name=name))
                     if data and compile_cache.write_entry(name, data):
                         tracing.counter("compile_cache.pull")
+                        pulled += 1
                 except Exception:
                     tracing.counter("compile_cache.prewarm_failed")
+        if pulled:
+            # one journal event per pre-warm, not per entry
+            events.emit("compile_cache_pull", worker=self.server.worker_id,
+                        entries=pulled)
 
     def _push_compile_cache(self) -> None:
         """Heartbeat-time push of entries this worker compiled since the
@@ -736,6 +749,7 @@ class Worker:
                     name=name, data=compile_cache.encode_entry(data)))
 
         confirmed = 0
+        pushed = 0
         try:
             for i, body in enumerate(rpc.flight_actions_raw(
                     self.coordinator, actions())):
@@ -747,6 +761,7 @@ class Worker:
                 # drop the entry from replication forever
                 if resp.get("stored"):
                     tracing.counter("compile_cache.push")
+                    pushed += 1
                     self._push_failures.pop(name, None)
                 else:
                     self._note_push_failure(name)
@@ -757,6 +772,12 @@ class Worker:
             # entry can't starve those sorting after it
             for name in attempted[confirmed:]:
                 self._note_push_failure(name)
+        if pushed:
+            # one journal event per heartbeat sync, not per entry; `server`
+            # may be absent under the push-only unit harness
+            srv = getattr(self, "server", None)
+            events.emit("compile_cache_push",
+                        worker=srv.worker_id if srv else "", entries=pushed)
 
     def _note_push_failure(self, name: str) -> None:
         """3-strike bookkeeping: un-know the entry so the next beat retries
@@ -774,13 +795,17 @@ class Worker:
         # answers ok=false and we re-register
         import sys
         while not self._stop.wait(self.heartbeat_interval_s):
+            # journal events ride the heartbeat (WORKER_INFO.events); on a
+            # failed beat they are requeued so the journal stays lossless
+            # across transient outages
+            evs = events.drain_forward()
             try:
                 resp = self._coordinator_action(
                     "heartbeat",
                     serde.worker_info_to_json(
                         self.server.worker_id, self.server.advertise,
                         devices=self.server.mesh_devices,
-                        slots=self.server.slots))
+                        slots=self.server.slots, events=evs))
                 if not resp.get("ok", True):
                     self._register()
                     tracing.counter("worker.reregistrations")
@@ -790,6 +815,7 @@ class Worker:
                     print(f"igloo-worker {self.server.worker_id}: heartbeat "
                           f"to {self.coordinator} recovered", file=sys.stderr)
             except Exception as ex:
+                events.requeue_forward(evs)
                 tracing.counter("worker.heartbeat_failures")
                 if not self._hb_down:
                     # log the EDGE, count the repeats: one line per outage

@@ -31,7 +31,7 @@ from igloo_tpu.plan.binder import Binder
 from igloo_tpu.plan.optimizer import last_adaptive_decisions, optimize
 from igloo_tpu.sql import ast as A
 from igloo_tpu.sql.parser import parse_sql
-from igloo_tpu.utils import stats, tracing
+from igloo_tpu.utils import stats, tracing, watch
 from igloo_tpu.utils.tracing import span
 
 
@@ -301,6 +301,22 @@ class QueryEngine:
         selectivity is derivable. Best-effort by contract: stale or missing
         stats mis-route plans, never break them."""
         from igloo_tpu.exec import hints
+        peak = 0
+        if qs is not None:
+            # watchtower baseline check (docs/observability.md#watchtower):
+            # BEFORE the adaptive gate — the anomaly detector is independent
+            # of IGLOO_ADAPTIVE (its own kill switch is IGLOO_WATCH, checked
+            # inside check_query). Runs after stats.collect published the
+            # trace, so an escalation's pin() finds it ring-resident.
+            # The one post-query watermark read, shared with the adaptive
+            # recorder below.
+            peak = stats.device_peak_hbm_bytes()
+            watch.check_query(
+                hints.plan_fp(plan) if plan is not None else None,
+                qs.elapsed_s, qs=qs, qid=str(qs.qid or ""),
+                trace_id=qs.trace_id or "", sql=qs.sql, tier=qs.tier,
+                hbm_bytes=(float(peak - peak_hbm0)
+                           if peak > peak_hbm0 else 0.0))
         if qs is None or not hints.adaptive_enabled():
             return
         obs = {k: n for k, n in qs.observations if k is not None}
@@ -316,7 +332,7 @@ class QueryEngine:
         # bound involving this query, which is the right direction.
         peak_hbm = 0
         if root_fp is not None:
-            peak_hbm = stats.device_peak_hbm_bytes()
+            peak_hbm = peak
             if peak_hbm <= peak_hbm0:
                 peak_hbm = 0
         if not obs and not peak_hbm:
@@ -470,6 +486,9 @@ class QueryEngine:
                 return self._execute_plan(plan), plan
         except SnapshotChanged as ex:
             tracing.counter("storage.snapshot_retry")
+            from igloo_tpu.cluster import events
+            events.emit("snapshot_retry", severity="warn",
+                        table=ex.table or "")
             tracing.log.warning(
                 "storage: snapshot changed mid-query (%s); re-planning once",
                 ex)

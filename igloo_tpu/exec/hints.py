@@ -196,6 +196,76 @@ class AdaptiveStats(_JsonStore):
                 self._dirty = True
 
 
+class BaselineStats(_JsonStore):
+    """Rolling per-fingerprint performance baselines for the watchtower's
+    anomaly detector (docs/observability.md#watchtower): bounded windows of
+    observed wall seconds, peak-HBM bytes, and exchange bytes per `plan_fp`
+    key. Quantiles are computed from the window at read time — a WINDOW of
+    64 keeps every digest a few hundred bytes in the JSON file while P99
+    still reflects the recent regime, and a plan whose cost legitimately
+    shifts (data grew) re-baselines itself within one window.
+
+    Same safety contract as AdaptiveStats: a stale or collided baseline can
+    only mis-CLASSIFY a query as slow/normal — escalation captures extra
+    telemetry, it never changes a plan or a result."""
+
+    _FIELDS = ("wall_s", "hbm_bytes", "exchange_bytes")
+    WINDOW = 64
+
+    def _coerce(self, raw: dict) -> dict:
+        out = {}
+        for k, v in raw.items():
+            if not isinstance(v, dict):
+                continue
+            rec: dict = {"count": int(v.get("count", 0))}
+            for f in self._FIELDS:
+                vals = v.get(f)
+                if isinstance(vals, list):
+                    rec[f] = [float(x) for x in vals][-self.WINDOW:]
+            out[k] = rec
+        return out
+
+    def observe(self, key, wall_s: Optional[float] = None,
+                hbm_bytes: Optional[float] = None,
+                exchange_bytes: Optional[float] = None) -> None:
+        fields = {"wall_s": wall_s, "hbm_bytes": hbm_bytes,
+                  "exchange_bytes": exchange_bytes}
+        clean = {k: float(v) for k, v in fields.items() if v is not None}
+        if not clean:
+            return
+        d = _digest(key)
+        with self._lock:
+            rec = self._data.setdefault(d, {"count": 0})
+            rec["count"] = int(rec.get("count", 0)) + 1
+            for f, v in clean.items():
+                window = rec.setdefault(f, [])
+                window.append(v)
+                del window[:-self.WINDOW]
+            self._dirty = True
+
+    @staticmethod
+    def _quantile(vals: list, q: float) -> float:
+        if not vals:
+            return 0.0
+        s = sorted(vals)
+        idx = min(max(int(q * len(s) + 0.999999) - 1, 0), len(s) - 1)
+        return s[idx]
+
+    def baseline(self, key) -> dict:
+        """Digest summary: observation count plus P50/P99 of each window
+        (0.0 where nothing was observed)."""
+        with self._lock:
+            rec = self._data.get(_digest(key))
+            rec = {k: (list(v) if isinstance(v, list) else v)
+                   for k, v in rec.items()} if rec else {}
+        out = {"count": int(rec.get("count", 0))}
+        for f in self._FIELDS:
+            vals = rec.get(f) or []
+            out[f"{f}_p50"] = self._quantile(vals, 0.50)
+            out[f"{f}_p99"] = self._quantile(vals, 0.99)
+        return out
+
+
 def row_width_bytes(schema) -> int:
     """Estimated bytes per row for observed-rows -> bytes conversion. The
     join reorder (plan/optimizer.py) and the broadcast switch
@@ -248,6 +318,16 @@ def plan_fp(plan):
     if t is L.Distinct:
         sub = plan_fp(plan.input)
         return sub and ("distinct", sub)
+    if t is L.Sort:
+        # ORDER BY must not poison the key: production queries near-always
+        # sort their output, and an unkeyed plan gets no latency baseline
+        # (docs/observability.md#watchtower)
+        sub = plan_fp(plan.input)
+        kr = xr((plan.keys, plan.ascending, plan.nulls_first))
+        return sub and kr and ("sort", kr, sub)
+    if t is L.Limit:
+        sub = plan_fp(plan.input)
+        return sub and ("limit", plan.limit, plan.offset, sub)
     return None  # unbounded/unhandled shapes: no stable key
 
 
@@ -291,3 +371,34 @@ def reset_adaptive_store() -> None:
     global _adaptive_singleton
     with _adaptive_singleton_lock:
         _adaptive_singleton = None
+
+
+_watch_singleton_lock = threading.Lock()
+_watch_singleton: Optional[BaselineStats] = None
+
+WATCH_PATH_ENV = "IGLOO_WATCH_STATS"
+
+
+def watch_store() -> BaselineStats:
+    """Process-wide BaselineStats for the watchtower detector
+    (utils/watch.py). Path precedence mirrors adaptive_store():
+    IGLOO_WATCH_STATS env > beside the persistent XLA cache > in-memory
+    only (baselines still build within the process; nothing persists)."""
+    global _watch_singleton
+    with _watch_singleton_lock:
+        if _watch_singleton is None:
+            path = os.environ.get(WATCH_PATH_ENV)
+            if path is None:
+                from igloo_tpu import compile_cache
+                cache_dir = compile_cache.active_dir()
+                if cache_dir:
+                    path = os.path.join(cache_dir, "watch_baselines.json")
+            _watch_singleton = BaselineStats(path or None)
+        return _watch_singleton
+
+
+def reset_watch_store() -> None:
+    """Drop the process singleton (tests re-point IGLOO_WATCH_STATS)."""
+    global _watch_singleton
+    with _watch_singleton_lock:
+        _watch_singleton = None

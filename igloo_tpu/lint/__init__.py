@@ -32,6 +32,10 @@ visitors (docs/static_analysis.md has the rule catalog):
                       Trace.add_span / request_scope) must match the Span
                       catalog in docs/observability.md — timeline names
                       must not typo-fork any more than metric names can;
+- ``event-names``     cluster-journal event kinds (``events.emit``) must
+                      match the Event catalog in docs/observability.md —
+                      the journal's kinds are its schema (dashboards and
+                      ``igloo_events_total{kind=...}`` filter on them);
 - ``rpc-policy``      no ``flight.connect`` / ``FlightClient`` outside
                       ``cluster/rpc.py`` — every Flight connection must run
                       under the RPC policy (deadlines, retry/backoff), or a
@@ -213,6 +217,7 @@ def iter_package_files(root: Path = PACKAGE_ROOT) -> list[Path]:
 def default_checkers() -> list:
     from igloo_tpu.lint.cache_key import CacheKeyChecker
     from igloo_tpu.lint.env_knobs import EnvKnobsChecker
+    from igloo_tpu.lint.event_names import EventNamesChecker
     from igloo_tpu.lint.flight_actions import FlightActionsChecker
     from igloo_tpu.lint.jit_key import JitKeyChecker
     from igloo_tpu.lint.lock_discipline import LockDisciplineChecker
@@ -224,9 +229,9 @@ def default_checkers() -> list:
     from igloo_tpu.lint.wire_contract import WireContractChecker
     return [SyncHazardChecker(), CacheKeyChecker(), JitKeyChecker(),
             LockDisciplineChecker(), MetricNamesChecker(),
-            SpanNamesChecker(), RpcPolicyChecker(), PallasDispatchChecker(),
-            WireContractChecker(), FlightActionsChecker(),
-            EnvKnobsChecker()]
+            SpanNamesChecker(), EventNamesChecker(), RpcPolicyChecker(),
+            PallasDispatchChecker(), WireContractChecker(),
+            FlightActionsChecker(), EnvKnobsChecker()]
 
 
 def _raw_lint(modules: list, checkers: list) -> tuple[list, list]:

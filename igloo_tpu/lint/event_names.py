@@ -1,0 +1,114 @@
+"""event-names: cluster-journal event kinds must match the catalog.
+
+Sibling of ``metric-names`` / ``span-names`` for the watchtower's event
+journal (cluster/events.py): every ``events.emit("<kind>", ...)`` call
+site's kind literal must be covered by the "Event catalog" table in
+docs/observability.md. Event kinds are the journal's schema — dashboards
+filter on them, ``igloo_events_total{kind=...}`` labels carry them, and
+the incident-reconstruction story depends on ``worker_evict`` never
+typo-forking into ``worker_evicted``.
+
+Rules:
+- the kind must be a string literal (a computed kind cannot be held to
+  the catalog and is flagged);
+- the literal must appear in the catalog verbatim.
+
+Catalog entries no code emits are warnings only (same stance as the
+metric/span rules: a documented-but-dormant kind is suspicious, not
+fatal).
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Iterable, Optional
+
+from igloo_tpu.lint import REPO_ROOT, Checker, Finding, LintModule
+
+RULE = "event-names"
+
+# the one way a kind enters the journal: events.emit("kind", ...) — kinds
+# are lowercase snake_case words
+EMIT_CALL_RE = re.compile(
+    r"(?<![\w.])events\.emit\(\s*(f?)[\"']([a-z][a-z0-9_]*)[\"']")
+# a non-literal first argument (variable, f-string with braces) cannot be
+# checked against the catalog
+EMIT_DYNAMIC_RE = re.compile(
+    r"(?<![\w.])events\.emit\(\s*(?![\"']|f[\"'])([A-Za-z_][\w.]*)")
+DOC_NAME_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+
+
+class EventNamesChecker(Checker):
+    name = RULE
+
+    #: overridable for fixture tests (None -> docs/observability.md)
+    doc_path: Optional[Path] = None
+
+    def __init__(self, doc_path: Optional[Path] = None):
+        if doc_path is not None:
+            self.doc_path = Path(doc_path)
+        self.sites: list[tuple] = []       # (kind, path, line)
+        self.dynamic: list[tuple] = []     # (expr, path, line)
+        self.warnings: list[str] = []
+
+    def check(self, mod: LintModule) -> Iterable[Finding]:
+        text = mod.text
+        for m in EMIT_CALL_RE.finditer(text):
+            line = text[: m.start()].count("\n") + 1
+            if m.group(1) == "f" and "{" in m.group(2):
+                self.dynamic.append((m.group(2), mod.relpath, line))
+            else:
+                self.sites.append((m.group(2), mod.relpath, line))
+        for m in EMIT_DYNAMIC_RE.finditer(text):
+            line = text[: m.start()].count("\n") + 1
+            self.dynamic.append((m.group(1), mod.relpath, line))
+        return ()
+
+    def _catalog(self) -> Optional[set]:
+        doc = self.doc_path if self.doc_path is not None \
+            else REPO_ROOT / "docs" / "observability.md"
+        if not doc.exists():
+            return None
+        text = doc.read_text()
+        start = text.find("### Event catalog")
+        if start < 0:
+            return None
+        ends = [e for e in (text.find("\n## ", start),
+                            text.find("\n### ", start + 1)) if e >= 0]
+        section = text[start:min(ends)] if ends else text[start:]
+        # kinds come from the table's FIRST column only — the meaning
+        # column backticks ordinary words too
+        cells = [ln.split("|")[1] for ln in section.splitlines()
+                 if ln.lstrip().startswith("|") and ln.count("|") >= 2]
+        return set(DOC_NAME_RE.findall("\n".join(cells)))
+
+    def finalize(self, modules: list) -> Iterable[Finding]:
+        catalog = self._catalog()
+        if catalog is None:
+            return [Finding(RULE, "docs/observability.md", 1,
+                            "event catalog section is missing")]
+        out: list[Finding] = []
+        used: set = set()
+        for kind, path, line in self.sites:
+            used.add(kind)
+            if kind not in catalog:
+                out.append(Finding(
+                    RULE, path, line, f"event kind `{kind}` is not "
+                    "documented in docs/observability.md (Event catalog)"))
+        for expr, path, line in self.dynamic:
+            out.append(Finding(
+                RULE, path, line, f"event kind `{expr}` is not a string "
+                "literal — the catalog cannot hold it"))
+        # unused-entry warnings only on a whole-package run (same rule as
+        # metric-names: a partial run would drown real warnings)
+        from igloo_tpu.lint import REPO_ROOT as _root
+        from igloo_tpu.lint import iter_package_files
+        linted = {m.relpath for m in modules}
+        pkg = {p.resolve().relative_to(_root.resolve()).as_posix()
+               for p in iter_package_files()}
+        if pkg and pkg <= linted:
+            for entry in sorted(catalog - used):
+                self.warnings.append(
+                    f"event-names: catalog entry `{entry}` matches no "
+                    "code emit site")
+        return out

@@ -50,6 +50,10 @@ def record(key: str, etag: str, row_group: int, reason: str,
                 _bad.popitem(last=False)
     if fresh:
         tracing.counter("storage.corrupt")
+        from igloo_tpu.cluster import events
+        events.emit("corruption_quarantine", severity="error",
+                    key=key, row_group=int(row_group), table=table,
+                    reason=reason)
         log.warning("storage: quarantined corrupt object %s row-group %d"
                     "%s: %s", key, row_group,
                     f" (table {table})" if table else "", reason)

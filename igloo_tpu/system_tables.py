@@ -1,7 +1,8 @@
-"""SQL-queryable telemetry: the `system.metrics` and `system.query_log`
-tables.
+"""SQL-queryable telemetry: the `system.*` tables — `system.metrics`,
+`system.query_log`, `system.query_traces`, and the watchtower trio
+`system.metrics_history` / `system.slow_queries` / `system.cluster_events`.
 
-Both are ordinary TableProviders registered in every QueryEngine's catalog
+All are ordinary TableProviders registered in every QueryEngine's catalog
 under the `system.` namespace (Catalog.register_system — resolvable by the
 binder, hidden from SHOW TABLES), so `SELECT * FROM system.metrics` runs
 through the normal parse -> bind -> optimize -> execute path like any other
@@ -19,8 +20,9 @@ from typing import Optional
 
 import pyarrow as pa
 
+from igloo_tpu.cluster import events
 from igloo_tpu.exec.batch import schema_from_arrow
-from igloo_tpu.utils import flight_recorder, stats, tracing
+from igloo_tpu.utils import flight_recorder, stats, timeseries, tracing, watch
 
 
 class _SystemTable:
@@ -179,8 +181,105 @@ class QueryTracesTable(_SystemTable):
             schema=self._arrow_schema)
 
 
+class MetricsHistoryTable(_SystemTable):
+    """`system.metrics_history`: the watchtower sampler ring
+    (utils/timeseries.py) flattened to one row per series per sample —
+    `kind` is 'rate' (counter first-derivative, per second) or 'gauge'
+    (instantaneous). `source` labels the sampling process; a coordinator's
+    local table shows its own ring, the `metrics_history` Flight action
+    aggregates the workers'. Empty until `IGLOO_WATCH` sampling runs."""
+
+    _arrow_schema = pa.schema([
+        pa.field("ts", pa.float64(), False),
+        pa.field("source", pa.string(), False),
+        pa.field("kind", pa.string(), False),
+        pa.field("name", pa.string(), False),
+        pa.field("value", pa.float64(), False),
+    ])
+
+    def _build(self) -> pa.Table:
+        cols: dict = {f.name: [] for f in self._arrow_schema}
+        for sample in timeseries.samples():
+            for kind in ("rates", "gauges"):
+                for name, v in sorted((sample.get(kind) or {}).items()):
+                    cols["ts"].append(float(sample.get("ts", 0.0)))
+                    cols["source"].append(str(sample.get("source", "")))
+                    cols["kind"].append(kind[:-1])
+                    cols["name"].append(name)
+                    cols["value"].append(float(v))
+        return pa.Table.from_arrays(
+            [pa.array(cols[f.name], type=f.type) for f in self._arrow_schema],
+            schema=self._arrow_schema)
+
+
+class SlowQueriesTable(_SystemTable):
+    """`system.slow_queries`: the watchtower's anomaly escalations
+    (utils/watch.py) — queries that ran beyond IGLOO_WATCH_SLOW_FACTOR x
+    their own fingerprint's P99. Joins system.query_log /
+    system.query_traces on trace_id; the trace is pinned in the recorder,
+    so the evidence outlives ring eviction."""
+
+    _arrow_schema = pa.schema([
+        pa.field("ts", pa.float64(), False),
+        pa.field("qid", pa.string(), False),
+        pa.field("trace_id", pa.string(), False),
+        pa.field("fingerprint", pa.string(), False),
+        pa.field("observed_s", pa.float64(), False),
+        pa.field("baseline_p99_s", pa.float64(), False),
+        pa.field("factor", pa.float64(), False),
+        pa.field("observed_bytes", pa.float64(), False),
+        pa.field("baseline_p99_bytes", pa.float64(), False),
+        pa.field("dominant_phase", pa.string(), False),
+        pa.field("tier", pa.string(), False),
+        pa.field("sql", pa.string(), False),
+    ])
+
+    def _build(self) -> pa.Table:
+        recs = watch.slow_queries()
+        cols = {f.name: [r.get(f.name) for r in recs]
+                for f in self._arrow_schema}
+        return pa.Table.from_arrays(
+            [pa.array(cols[f.name], type=f.type) for f in self._arrow_schema],
+            schema=self._arrow_schema)
+
+
+class ClusterEventsTable(_SystemTable):
+    """`system.cluster_events`: the structured cluster journal
+    (cluster/events.py), oldest first — worker membership churn, fragment
+    recovery, admission sheds, demotions, cache traffic, plan flips, slow
+    queries. `attrs` is the event's extra attributes as a JSON string."""
+
+    _arrow_schema = pa.schema([
+        pa.field("ts", pa.float64(), False),
+        pa.field("kind", pa.string(), False),
+        pa.field("severity", pa.string(), False),
+        pa.field("worker", pa.string(), False),
+        pa.field("qid", pa.string(), False),
+        pa.field("trace_id", pa.string(), False),
+        pa.field("attrs", pa.string(), False),
+    ])
+
+    def _build(self) -> pa.Table:
+        cols: dict = {f.name: [] for f in self._arrow_schema}
+        for ev in events.events():
+            cols["ts"].append(float(ev.get("ts", 0.0)))
+            cols["kind"].append(str(ev.get("kind", "")))
+            cols["severity"].append(str(ev.get("severity", "info")))
+            cols["worker"].append(str(ev.get("worker", "")))
+            cols["qid"].append(str(ev.get("qid", "")))
+            cols["trace_id"].append(str(ev.get("trace_id", "")))
+            cols["attrs"].append(json.dumps(ev.get("attrs") or {},
+                                            default=str))
+        return pa.Table.from_arrays(
+            [pa.array(cols[f.name], type=f.type) for f in self._arrow_schema],
+            schema=self._arrow_schema)
+
+
 def register_system_tables(catalog) -> None:
     """Install the system namespace into a catalog (engine construction)."""
     catalog.register_system("system.metrics", MetricsTable())
     catalog.register_system("system.query_log", QueryLogTable())
     catalog.register_system("system.query_traces", QueryTracesTable())
+    catalog.register_system("system.metrics_history", MetricsHistoryTable())
+    catalog.register_system("system.slow_queries", SlowQueriesTable())
+    catalog.register_system("system.cluster_events", ClusterEventsTable())

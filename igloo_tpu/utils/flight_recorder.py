@@ -55,10 +55,19 @@ _tls = threading.local()
 # appended by whichever thread finishes a query and read by system-table
 # scans / the trace Flight action; a Trace's span list is appended from
 # handler, dispatch-pool, relay, and adopted worker threads at once
-_GUARDED_BY = {"_ring_lock": ("_ring",), "_lock": ("_spans",)}
+_GUARDED_BY = {"_ring_lock": ("_ring", "_pinned"), "_lock": ("_spans",)}
 
 _ring_lock = threading.Lock()
 _ring: deque = deque(maxlen=max(int(os.environ.get(RING_ENV, "32") or 32), 1))
+
+# Watchtower retention override (docs/observability.md#watchtower): a trace
+# `pin()`ed here survives ring eviction — the slow-query detector pins the
+# anomalous query's trace so the evidence is still readable after another
+# ring's worth of normal queries has flowed past. Bounded FIFO of LIVE
+# Trace objects (straggler spans still land), capped separately from the
+# ring so a burst of anomalies cannot grow memory unboundedly.
+_PIN_MAX = 32
+_pinned: "dict[str, Trace]" = {}
 
 
 def enabled() -> bool:
@@ -319,11 +328,51 @@ def publish(trace: Optional[Trace]) -> Optional[dict]:
     return rec
 
 
-def records() -> list:
-    """Ring-resident trace records, most recent last (snapshotted at read,
-    so post-publish straggler spans are included)."""
+def pin(trace_id: Optional[str] = None, qid: Optional[str] = None) -> bool:
+    """Force retention of a ring-resident trace beyond ring eviction (the
+    watchtower's slow-query escalation, utils/watch.py). Looks the trace up
+    by trace_id or qid in the ring (and among already-pinned traces — a
+    re-pin refreshes FIFO position); returns False when no such trace is
+    resident, True when pinned."""
+    if trace_id is None and qid is None:
+        return False
     with _ring_lock:
-        traces = list(_ring)
+        target = None
+        for t in reversed(_ring):
+            if ((trace_id is not None and t.trace_id == trace_id)
+                    or (qid is not None and t.qid == str(qid))):
+                target = t
+                break
+        if target is None:
+            for t in reversed(list(_pinned.values())):
+                if ((trace_id is not None and t.trace_id == trace_id)
+                        or (qid is not None and t.qid == str(qid))):
+                    target = t
+                    break
+        if target is None:
+            return False
+        _pinned.pop(target.trace_id, None)
+        _pinned[target.trace_id] = target
+        while len(_pinned) > _PIN_MAX:
+            _pinned.pop(next(iter(_pinned)))
+    tracing.counter("trace.pinned")
+    return True
+
+
+def _resident_locked() -> list:
+    """Pinned-but-evicted traces first (oldest), then the ring (most recent
+    last); a trace both pinned and ring-resident appears once."""
+    ring_ids = {t.trace_id for t in _ring}
+    out = [t for t in _pinned.values() if t.trace_id not in ring_ids]
+    out.extend(_ring)
+    return out
+
+
+def records() -> list:
+    """Resident trace records (ring + pinned), most recent last
+    (snapshotted at read, so post-publish straggler spans are included)."""
+    with _ring_lock:
+        traces = _resident_locked()
     return [t.to_record() for t in traces]
 
 
@@ -331,7 +380,7 @@ def get_record(trace_id: Optional[str] = None,
                qid: Optional[str] = None) -> Optional[dict]:
     """Look a trace up by trace_id or qid; neither = the most recent."""
     with _ring_lock:
-        traces = list(_ring)
+        traces = _resident_locked()
     if not traces:
         return None
     if trace_id is None and qid is None:
@@ -347,6 +396,7 @@ def get_record(trace_id: Optional[str] = None,
 def clear() -> None:
     with _ring_lock:
         _ring.clear()
+        _pinned.clear()
     tracing.REGISTRY.bump_version()
 
 

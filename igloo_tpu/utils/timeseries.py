@@ -1,0 +1,221 @@
+"""Watchtower time-series recorder (docs/observability.md#watchtower).
+
+A lock-guarded ring-buffer sampler over the process-wide MetricsRegistry:
+every `IGLOO_WATCH_INTERVAL_S` seconds it snapshots every gauge verbatim
+and a selected set of counters as per-second RATES (counters are
+monotonic, so the interesting signal is the first derivative — bytes/s
+over the exchange, retries/s against the object store, sheds/s at the
+admission gate). Memory is bounded by construction: the ring holds at
+most `IGLOO_WATCH_HISTORY` samples (default 720 = one hour at the 5 s
+default interval), each a small dict of floats.
+
+One sampler per process, started by the coordinator and by each worker
+(`start("coordinator"|"worker")`). Workers' rings are aggregated
+coordinator-side by the `metrics_history` Flight action with per-worker
+source labels; locally the ring backs the `system.metrics_history`
+table. `IGLOO_WATCH=0` is the watchtower kill switch: `start()` becomes
+a no-op, nothing samples, nothing is recorded — counters and plans are
+bit-identical to a build without the watchtower.
+
+Threading: `Sampler` is written to from its own daemon thread and read
+from Flight/system-table threads; all ring/previous-snapshot state is
+guarded by one lock. Rates are computed against the PREVIOUS sample's
+counter snapshot over monotonic elapsed time, so wall-clock steps do
+not corrupt them.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from igloo_tpu.utils import tracing
+
+#: process-unique sample ids: the coordinator's `metrics_history`
+#: aggregation dedupes on these, so an in-process test fleet (coordinator
+#: and workers sharing this module's one ring) doesn't triple-count
+_sid_seq = itertools.count(1)
+
+WATCH_ENV = "IGLOO_WATCH"
+INTERVAL_ENV = "IGLOO_WATCH_INTERVAL_S"
+HISTORY_ENV = "IGLOO_WATCH_HISTORY"
+
+# Counters sampled as per-second rates. A selection, not the whole
+# registry: the fleet-health series worth graphing over an hour —
+# data movement (exchange/spill/storage bytes), pressure (sheds,
+# retries, faults), and throughput (fragments, distributed queries).
+# Names must stay in the docs/observability.md metrics catalog
+# (metric-names lint).
+RATE_COUNTERS = (
+    "rpc.retries",
+    "rpc.timeouts",
+    "exchange.bytes",
+    "exchange.fetch_bytes",
+    "exchange.partition_bytes",
+    "exchange.spill_bytes",
+    "grace.partition_bytes",
+    "storage.read_bytes",
+    "storage.retry",
+    "serving.shed",
+    "serving.admitted",
+    "coordinator.fragments_dispatched",
+    "coordinator.distributed_queries",
+    "worker.fragments",
+    "compile_cache.hit",
+    "compile_cache.miss",
+    "faults.injected",
+    "events.emitted",
+)
+
+# The query-latency summary feeds two derived series: completion rate
+# (qps) and the windowed mean latency over the sampling interval.
+_LATENCY_HIST = "query.latency_s"
+
+
+def enabled() -> bool:
+    """Watchtower master switch — sampler, baselines, journal all key off
+    this ONE knob so `IGLOO_WATCH=0` is a complete kill switch."""
+    return os.environ.get("IGLOO_WATCH", "1") != "0"
+
+
+def interval_s() -> float:
+    return float(os.environ.get("IGLOO_WATCH_INTERVAL_S", "5"))
+
+
+def history() -> int:
+    return max(int(os.environ.get("IGLOO_WATCH_HISTORY", "720")), 1)
+
+
+class Sampler:
+    """Bounded ring of registry snapshots; one per process."""
+
+    _GUARDED_BY = {
+        "_lock": ("_ring", "_prev_counters", "_prev_hist", "_prev_mono"),
+    }
+
+    def __init__(self, source: str = "local",
+                 interval: Optional[float] = None,
+                 maxlen: Optional[int] = None):
+        self.source = source
+        self.interval = interval_s() if interval is None else float(interval)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=history() if maxlen is None
+                                  else max(int(maxlen), 1))
+        self._prev_counters: dict = {}
+        self._prev_hist: tuple = (0, 0.0)   # (count, sum) of _LATENCY_HIST
+        self._prev_mono: Optional[float] = None
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sampling --------------------------------------------------------
+
+    def sample_once(self, *, dt: Optional[float] = None) -> dict:
+        """Take one sample now and append it to the ring. `dt` overrides
+        the measured elapsed seconds (tests want exact rate arithmetic).
+        The first sample has no predecessor, so its rates are empty."""
+        counters = tracing.REGISTRY.counters()
+        gauges = tracing.REGISTRY.gauges()
+        hists = tracing.REGISTRY.histograms()
+        lat = hists.get(_LATENCY_HIST) or {"count": 0, "sum": 0.0}
+        now_mono = time.monotonic()
+        sample = {"sid": f"{os.getpid():x}-{next(_sid_seq)}",
+                  "ts": time.time(), "source": self.source,
+                  "rates": {}, "gauges": {k: float(v)
+                                          for k, v in gauges.items()}}
+        with self._lock:
+            elapsed = dt
+            if elapsed is None:
+                elapsed = (now_mono - self._prev_mono
+                           if self._prev_mono is not None else 0.0)
+            if elapsed > 0:
+                rates = sample["rates"]
+                for name in RATE_COUNTERS:
+                    cur = counters.get(name)
+                    if cur is None:
+                        continue
+                    prev = self._prev_counters.get(name, 0)
+                    rates[name] = max(cur - prev, 0) / elapsed
+                d_count = max(lat["count"] - self._prev_hist[0], 0)
+                rates["query.qps"] = d_count / elapsed
+                if d_count:
+                    d_sum = max(lat["sum"] - self._prev_hist[1], 0.0)
+                    sample["gauges"]["query.latency_mean_s"] = d_sum / d_count
+            self._prev_counters = {n: counters[n] for n in RATE_COUNTERS
+                                   if n in counters}
+            self._prev_hist = (lat["count"], lat["sum"])
+            self._prev_mono = now_mono
+            self._ring.append(sample)
+        tracing.counter("watch.samples")
+        return sample
+
+    def samples(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"igloo-watch-{self.source}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        # sample immediately so a freshly started process has a baseline
+        # row, then on the interval until stopped
+        while True:
+            try:
+                self.sample_once()
+            except Exception:
+                # the watchtower must never take the server down
+                pass
+            if self._stop_evt.wait(self.interval):
+                return
+
+
+# -- process-wide singleton ---------------------------------------------
+
+_sampler: Optional[Sampler] = None
+_sampler_lock = threading.Lock()
+
+
+def start(source: str = "local") -> Optional[Sampler]:
+    """Start the process sampler (idempotent; the FIRST caller's source
+    label wins — an in-process coordinator+worker test fleet shares one
+    ring). No-op returning None when `IGLOO_WATCH=0`."""
+    global _sampler
+    if not enabled():
+        return None
+    with _sampler_lock:
+        if _sampler is None:
+            _sampler = Sampler(source=source)
+            _sampler.start()
+        return _sampler
+
+
+def stop() -> None:
+    global _sampler
+    with _sampler_lock:
+        s, _sampler = _sampler, None
+    if s is not None:
+        s.stop()
+
+
+def get() -> Optional[Sampler]:
+    return _sampler
+
+
+def samples() -> list:
+    s = _sampler
+    return s.samples() if s is not None else []

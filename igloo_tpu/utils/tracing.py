@@ -184,25 +184,39 @@ def _sanitize(name: str) -> str:
 
 def prometheus_text(prefix: str = "igloo", extra_lines: Optional[list] = None
                     ) -> str:
-    """Render the registry in the Prometheus text exposition format.
-    Counters become `<prefix>_<name>_total`; histograms a summary-style
-    `_count`/`_sum` pair plus `_min`/`_max` gauges. `extra_lines` (already
-    formatted) are appended — the coordinator adds its per-worker fragment
-    aggregates there."""
+    """Render the registry in the Prometheus text exposition format —
+    conformant enough for a real scraper to ingest without a shim: every
+    metric family gets `# HELP` and `# TYPE` lines, counters become
+    `<prefix>_<name>_total`, histograms a summary family (its `_count` and
+    `_sum` series). Min/max have no standard slot in a summary, so they are
+    exposed as their OWN `_min`/`_max` gauge families rather than riding
+    untyped under the summary name. `extra_lines` (already formatted,
+    HELP/TYPE included where the producer wants them) are appended — the
+    coordinator adds its per-worker fragment aggregates and the cluster
+    journal's `igloo_events_total{kind=...}` there."""
     lines: list[str] = []
     for name, value in sorted(REGISTRY.counters().items()):
         m = f"{prefix}_{_sanitize(name)}_total"
+        lines.append(f"# HELP {m} Cumulative count of {name} "
+                     "(docs/observability.md#metrics-catalog).")
         lines.append(f"# TYPE {m} counter")
         lines.append(f"{m} {value}")
     for name, h in sorted(REGISTRY.histograms().items()):
         m = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# HELP {m} Summary of {name} observations "
+                     "(docs/observability.md#metrics-catalog).")
         lines.append(f"# TYPE {m} summary")
         lines.append(f"{m}_count {h['count']}")
         lines.append(f"{m}_sum {h['sum']}")
-        lines.append(f"{m}_min {h['min']}")
-        lines.append(f"{m}_max {h['max']}")
+        for bound in ("min", "max"):
+            b = f"{m}_{bound}"
+            lines.append(f"# HELP {b} All-time {bound} of {name}.")
+            lines.append(f"# TYPE {b} gauge")
+            lines.append(f"{b} {h[bound]}")
     for name, v in sorted(REGISTRY.gauges().items()):
         m = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# HELP {m} Instantaneous value of {name} "
+                     "(docs/observability.md#metrics-catalog).")
         lines.append(f"# TYPE {m} gauge")
         lines.append(f"{m} {v}")
     if extra_lines:

@@ -1,0 +1,158 @@
+"""Watchtower anomaly detector: per-fingerprint baselines and slow-query
+escalation (docs/observability.md#watchtower).
+
+At query end the engine (local tiers) and the coordinator (distributed
+path) call `check_query()` with the query's `plan_fp` structural
+fingerprint and observed cost. The detector compares wall seconds and
+exchange bytes against the fingerprint's OWN rolling P99 (BaselineStats,
+exec/hints.py — the AdaptiveStats JSON-store idiom): a query beyond
+`IGLOO_WATCH_SLOW_FACTOR` x P99 (default 3x), judged WARM-ONLY (at least
+`MIN_OBSERVATIONS` prior runs of the same fingerprint), escalates:
+
+- one row in the bounded `system.slow_queries` ring — fingerprint digest,
+  observed vs baseline, trace_id, and the dominant phase attributed from
+  the QueryStats operator tree;
+- the query's trace is PINNED in the flight recorder
+  (flight_recorder.pin) so the evidence survives ring eviction;
+- a `slow_query` event in the cluster journal (cluster/events.py);
+- a JSONL line in `$IGLOO_TRACE_DIR/slow_queries.jsonl`.
+
+Escalation fires at most once per qid (a bounded seen-set — retries and
+double-reporting paths cannot duplicate a row). The observation is folded
+into the baseline AFTER the comparison, so a query is always judged
+against history that does not include itself. `IGLOO_WATCH=0`
+(utils/timeseries.enabled) turns `check_query` into a no-op: no store
+writes, no counters — bit-identical to a build without the watchtower.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from igloo_tpu.utils import flight_recorder, timeseries, tracing
+
+SLOW_FACTOR_ENV = "IGLOO_WATCH_SLOW_FACTOR"
+
+#: warm-only gate: a fingerprint needs this many prior observations before
+#: its P99 is a baseline worth escalating against
+MIN_OBSERVATIONS = 5
+
+_lock = threading.Lock()
+_GUARDED_BY = {"_lock": ("_slow", "_escalated")}
+_slow: deque = deque(maxlen=timeseries.history())
+_escalated: deque = deque(maxlen=1024)   # qids already escalated (FIFO set)
+
+
+def slow_factor() -> float:
+    return float(os.environ.get("IGLOO_WATCH_SLOW_FACTOR", "3"))
+
+
+def _dominant_phase(qs) -> str:
+    """Attribute the anomaly: 'compile' when (re)compilation dominated the
+    wall, else the widest operator in the QueryStats tree."""
+    if qs is None:
+        return ""
+    try:
+        if qs.compile_s and qs.compile_s >= 0.5 * max(qs.elapsed_s, 1e-9):
+            return "compile"
+        best, best_wall = "", 0.0
+        for op in qs.ops():
+            if op.wall_s > best_wall:
+                best, best_wall = op.name, op.wall_s
+        return best or "execute"
+    except Exception:
+        return ""
+
+
+def _export(rec: dict) -> None:
+    out_dir = os.environ.get("IGLOO_TRACE_DIR")
+    if not out_dir:
+        return
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "slow_queries.jsonl"), "a",
+                  encoding="utf-8") as fh:
+            fh.write(json.dumps(rec, default=str) + "\n")
+    except OSError:
+        tracing.counter("watch.export_failed")
+
+
+def check_query(fp, wall_s: float, *, exchange_bytes: float = 0.0,
+                hbm_bytes: float = 0.0, qs=None, qid: str = "",
+                trace_id: str = "", sql: str = "",
+                tier: str = "", phase: str = "") -> Optional[dict]:
+    """Judge one finished query against its fingerprint's baseline, then
+    fold the observation in. Returns the slow-query record when escalated,
+    else None. Cheap by contract: a dict lookup, one sort of a <=64-entry
+    window, and a deque append — it sits on every query's exit path."""
+    if fp is None or not timeseries.enabled():
+        return None
+    from igloo_tpu.exec import hints
+    store = hints.watch_store()
+    base = store.baseline(fp)
+    record = None
+    if base["count"] >= MIN_OBSERVATIONS:
+        factor = slow_factor()
+        wall_thr = base["wall_s_p99"] * factor
+        bytes_thr = base["exchange_bytes_p99"] * factor
+        slow_wall = wall_thr > 0 and wall_s > wall_thr
+        slow_bytes = bytes_thr > 0 and exchange_bytes > bytes_thr
+        if slow_wall or slow_bytes:
+            record = self_rec = {
+                "ts": time.time(),
+                "qid": str(qid or ""),
+                "trace_id": str(trace_id or ""),
+                "fingerprint": hints.digest_key(fp),
+                "observed_s": float(wall_s),
+                "baseline_p99_s": base["wall_s_p99"],
+                "observed_bytes": float(exchange_bytes),
+                "baseline_p99_bytes": base["exchange_bytes_p99"],
+                "factor": (wall_s / base["wall_s_p99"]
+                           if base["wall_s_p99"] > 0 else 0.0),
+                "dominant_phase": phase or _dominant_phase(qs),
+                "tier": tier or (qs.tier if qs is not None else ""),
+                "sql": (sql or (qs.sql if qs is not None else ""))[:200],
+            }
+            with _lock:
+                if self_rec["qid"] and self_rec["qid"] in _escalated:
+                    record = None   # once per query, ever
+                else:
+                    if self_rec["qid"]:
+                        _escalated.append(self_rec["qid"])
+                    _slow.append(self_rec)
+            if record is not None:
+                tracing.counter("watch.slow_queries")
+                tracing.REGISTRY.bump_version()
+                if record["trace_id"] or record["qid"]:
+                    flight_recorder.pin(trace_id=record["trace_id"] or None,
+                                        qid=record["qid"] or None)
+                from igloo_tpu.cluster import events
+                events.emit("slow_query", severity="warn",
+                            qid=record["qid"], trace_id=record["trace_id"],
+                            factor=round(record["factor"], 2),
+                            dominant_phase=record["dominant_phase"])
+                _export(record)
+    # fold AFTER judging: the baseline a query is compared against never
+    # includes the query itself
+    store.observe(fp, wall_s=wall_s,
+                  hbm_bytes=hbm_bytes or None,
+                  exchange_bytes=exchange_bytes or None)
+    return record
+
+
+def slow_queries() -> list:
+    """Escalation records, oldest first (the system.slow_queries rows)."""
+    with _lock:
+        return list(_slow)
+
+
+def clear() -> None:
+    """Tests only: drop escalation state and re-bound the ring."""
+    global _slow
+    with _lock:
+        _slow = deque(maxlen=timeseries.history())
+        _escalated.clear()

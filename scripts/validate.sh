@@ -25,6 +25,9 @@ python scripts/encoded_smoke.py
 echo "== trace smoke (flight recorder: stitched 2-worker Perfetto trace) =="
 python scripts/trace_smoke.py
 
+echo "== watchtower smoke (sampler + slow-query escalation + event journal) =="
+python scripts/watchtower_smoke.py
+
 echo "== bench gate (perf regression vs committed baseline) =="
 python scripts/bench_gate.py --selftest
 python scripts/bench_gate.py

@@ -59,6 +59,26 @@ def _fresh_adaptive_store():
     hints.reset_adaptive_store()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_watchtower():
+    """Watchtower state (utils/watch.py baselines + escalations,
+    cluster/events.py journal) is process-global like the adaptive store,
+    and for the same reason must not leak across tests — an escalation
+    threshold warmed by one test would change what another escalates.
+    The SAMPLER singleton (utils/timeseries.py) is deliberately left
+    alone: module-scoped cluster fixtures own it for their lifetime."""
+    from igloo_tpu.cluster import events
+    from igloo_tpu.exec import hints
+    from igloo_tpu.utils import watch
+    hints.reset_watch_store()
+    watch.clear()
+    events.clear()
+    yield
+    hints.reset_watch_store()
+    watch.clear()
+    events.clear()
+
+
 # NOTE (round 4): a session-shared jit compile cache was tried here to cut
 # CPU compile time and REVERTED: keeping every compiled XLA:CPU executable
 # alive for the whole session reproducibly segfaulted the process in

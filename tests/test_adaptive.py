@@ -87,9 +87,17 @@ def test_plan_fp_shapes():
     jp = eng.plan("SELECT a FROM t JOIN u ON t.a = u.b")
     fps = [hints.plan_fp(n) for n in L.walk_plan(jp)]
     assert any(fp is not None for fp in fps)
-    # unhandled root shapes (Sort) have no stable key
+    # ORDER BY keys stably (watchtower baselines would otherwise skip
+    # nearly every production query); direction flips the key
     sp = eng.plan("SELECT a FROM t ORDER BY a")
-    assert hints.plan_fp(sp) is None
+    assert hints.plan_fp(sp) is not None
+    assert hints.plan_fp(sp) == hints.plan_fp(eng.plan(
+        "SELECT a FROM t ORDER BY a"))
+    assert hints.plan_fp(sp) != hints.plan_fp(eng.plan(
+        "SELECT a FROM t ORDER BY a DESC"))
+    # truly unhandled root shapes (set ops) still have no stable key
+    up = eng.plan("SELECT a FROM t UNION ALL SELECT b AS a FROM u")
+    assert hints.plan_fp(up) is None
     fp = next(fp for fp in fps if fp is not None)
     assert hints.digest_key(fp) == hints.digest_key(fp)
 

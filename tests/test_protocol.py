@@ -110,7 +110,8 @@ def test_worker_info_legacy_payload_parses_through_defaults():
     defaults: devices=1, slots=0 — the planner sizes exactly as before
     two-level parallelism."""
     info = serde.worker_info_from_json({"id": "w0"})
-    assert info == {"id": "w0", "addr": "", "devices": 1, "slots": 0}
+    assert info == {"id": "w0", "addr": "", "devices": 1, "slots": 0,
+                    "events": []}
     with pytest.raises(ProtocolError, match="missing required field 'id'"):
         serde.worker_info_from_json({"addr": "x"})
 

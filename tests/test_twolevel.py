@@ -173,7 +173,7 @@ def test_worker_info_serde_roundtrip_and_legacy():
     d = serde.worker_info_to_json("w1", "grpc+tcp://h:1", devices=4, slots=2)
     info = serde.worker_info_from_json(d)
     assert info == {"id": "w1", "addr": "grpc+tcp://h:1", "devices": 4,
-                    "slots": 2}
+                    "slots": 2, "events": []}
     # the retired wall-clock `ts` field must be GONE from the payload (no
     # consumer ever read it — wire-contract true positive, PR 14) but a
     # legacy payload still carrying it must parse untouched

@@ -1,0 +1,331 @@
+"""Watchtower tests: the time-series sampler's bounded memory and rate
+arithmetic, per-fingerprint latency baselines and slow-query escalation
+(exactly-once, trace pinning, warm-only), the cluster event journal
+(bound, severity filter, heartbeat forwarding), the `igloo top` renderer,
+and the IGLOO_WATCH=0 kill switch (docs/observability.md#watchtower)."""
+import json
+import threading
+import time
+
+import pytest
+
+from igloo_tpu.cluster import events
+from igloo_tpu.exec import hints
+from igloo_tpu.utils import flight_recorder, timeseries, tracing, watch
+
+
+# --- time-series sampler -----------------------------------------------
+
+
+def test_sampler_ring_is_bounded():
+    s = timeseries.Sampler(source="t", maxlen=5)
+    for _ in range(23):
+        s.sample_once(dt=1.0)
+    got = s.samples()
+    assert len(got) == 5
+    assert all(sm["source"] == "t" for sm in got)
+
+
+def test_sampler_rates_exact():
+    s = timeseries.Sampler(source="t", maxlen=8)
+    # the first sample has no predecessor: no rates at all
+    assert s.sample_once()["rates"] == {}
+    tracing.counter("rpc.retries", 6)
+    tracing.histogram("query.latency_s", 0.5)
+    tracing.histogram("query.latency_s", 1.5)
+    sm = s.sample_once(dt=2.0)
+    assert sm["rates"]["rpc.retries"] == pytest.approx(3.0)
+    assert sm["rates"]["query.qps"] == pytest.approx(1.0)
+    assert sm["gauges"]["query.latency_mean_s"] == pytest.approx(1.0)
+
+
+def test_sampler_rates_under_concurrent_bumps():
+    """All bumps between two samples are attributed to that interval,
+    regardless of which thread made them."""
+    s = timeseries.Sampler(source="t", maxlen=8)
+    s.sample_once(dt=1.0)
+    n_threads, per_thread = 8, 250
+
+    def bump():
+        for _ in range(per_thread):
+            tracing.counter("worker.fragments")
+
+    threads = [threading.Thread(target=bump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sm = s.sample_once(dt=4.0)
+    assert sm["rates"]["worker.fragments"] == pytest.approx(
+        n_threads * per_thread / 4.0)
+
+
+def test_sampler_sids_are_unique():
+    s = timeseries.Sampler(source="t", maxlen=16)
+    for _ in range(10):
+        s.sample_once(dt=1.0)
+    sids = [sm["sid"] for sm in s.samples()]
+    assert len(set(sids)) == len(sids)
+
+
+# --- latency baselines (BaselineStats) ---------------------------------
+
+
+def test_baseline_roundtrip(tmp_path):
+    path = str(tmp_path / "watch.json")
+    store = hints.BaselineStats(path)
+    for v in (0.010, 0.011, 0.012, 0.013, 0.014):
+        store.observe("fp-a", wall_s=v, exchange_bytes=100.0)
+    store.flush()
+    reloaded = hints.BaselineStats(path)
+    base = reloaded.baseline("fp-a")
+    assert base["count"] == 5
+    assert base["wall_s_p99"] == pytest.approx(0.014)
+    assert base["wall_s_p50"] == pytest.approx(0.012)
+    assert base["exchange_bytes_p99"] == pytest.approx(100.0)
+
+
+def test_baseline_tolerates_corrupt_file(tmp_path):
+    path = tmp_path / "watch.json"
+    path.write_text("{ this is not json !!!")
+    store = hints.BaselineStats(str(path))        # must not raise
+    assert store.baseline("fp-a")["count"] == 0
+    # valid JSON with hostile value shapes is coerced, not crashed on
+    path.write_text(json.dumps({"k1": "scalar", "k2": {"count": "3",
+                                "wall_s": [1, "2", 3.5]}}))
+    store = hints.BaselineStats(str(path))
+    assert store.baseline("fp-a")["count"] == 0
+
+
+# --- slow-query escalation ---------------------------------------------
+
+
+def _warm(fp, n=watch.MIN_OBSERVATIONS, wall=0.01):
+    store = hints.watch_store()
+    for _ in range(n):
+        store.observe(fp, wall_s=wall)
+
+
+def test_no_escalation_below_min_observations():
+    _warm("fp-cold", n=watch.MIN_OBSERVATIONS - 1)
+    rec = watch.check_query("fp-cold", 10.0, qid="q-cold")
+    assert rec is None
+    assert watch.slow_queries() == []
+    # the observation still folded in (count advanced past the gate)
+    assert hints.watch_store().baseline("fp-cold")["count"] == \
+        watch.MIN_OBSERVATIONS
+
+
+def test_escalation_fires_exactly_once_and_pins_trace():
+    trace = flight_recorder.Trace(qid="q-slow")
+    trace.add_span("query", 0.0, 1.0)
+    flight_recorder.publish(trace)
+    _warm("fp-hot")
+    rec = watch.check_query("fp-hot", 1.0, qid="q-slow",
+                            trace_id=trace.trace_id, sql="SELECT 1",
+                            tier="device")
+    assert rec is not None
+    assert rec["factor"] == pytest.approx(1.0 / 0.01)
+    assert rec["fingerprint"]
+    assert [r["qid"] for r in watch.slow_queries()] == ["q-slow"]
+    assert events.events()[-1]["kind"] == "slow_query"
+    # once per qid, ever — a retry/double-report path cannot duplicate
+    assert watch.check_query("fp-hot", 1.0, qid="q-slow",
+                             trace_id=trace.trace_id) is None
+    assert len(watch.slow_queries()) == 1
+    # the pin keeps the evidence past ring eviction
+    for i in range((flight_recorder._ring.maxlen or 32) + 4):
+        flight_recorder.publish(flight_recorder.Trace(qid=f"filler{i}"))
+    got = flight_recorder.get_record(trace_id=trace.trace_id)
+    assert got is not None and got["qid"] == "q-slow"
+
+
+def test_normal_query_does_not_escalate():
+    _warm("fp-ok")
+    assert watch.check_query("fp-ok", 0.011, qid="q-ok") is None
+    assert watch.slow_queries() == []
+
+
+def test_escalation_exports_jsonl(tmp_path, monkeypatch):
+    monkeypatch.setenv("IGLOO_TRACE_DIR", str(tmp_path))
+    _warm("fp-exp")
+    assert watch.check_query("fp-exp", 2.0, qid="q-exp") is not None
+    lines = (tmp_path / "slow_queries.jsonl").read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["qid"] == "q-exp"
+
+
+# --- cluster event journal ---------------------------------------------
+
+
+def test_journal_ring_bound_and_severity_filter(monkeypatch):
+    monkeypatch.setenv("IGLOO_WATCH_HISTORY", "10")
+    events.clear()                      # re-bound from the patched env
+    try:
+        for i in range(25):
+            events.emit("worker_join", worker=f"w{i}")
+        events.emit("worker_evict", severity="warn", worker="wX")
+        events.emit("corruption_quarantine", severity="error", key="k")
+        assert len(events.events()) == 10
+        warm = events.events(min_severity="warn")
+        assert [e["kind"] for e in warm] == ["worker_evict",
+                                            "corruption_quarantine"]
+        assert [e["kind"] for e in events.events(min_severity="error")] == \
+            ["corruption_quarantine"]
+        assert events.events(limit=3)[-1]["kind"] == "corruption_quarantine"
+        # per-kind totals survive ring eviction
+        assert events.counts()["worker_join"] == 25
+    finally:
+        monkeypatch.delenv("IGLOO_WATCH_HISTORY")
+        events.clear()
+
+
+def test_journal_forwarding_dedup_and_labeling():
+    # worker side: emit queues for forwarding; drain pops in order
+    e1 = events.emit("fragment_requeue_busy", qid="q1", worker="w1")
+    e2 = events.emit("snapshot_retry", severity="warn")
+    batch = events.drain_forward()
+    assert [e["eid"] for e in batch] == [e1["eid"], e2["eid"]]
+    assert events.drain_forward() == []
+    # failed heartbeat: requeue preserves order for the next beat
+    events.requeue_forward(batch)
+    assert [e["eid"] for e in events.drain_forward()] == \
+        [e1["eid"], e2["eid"]]
+    # coordinator side: an in-process fleet's events are already journaled
+    # (same eids) — ingest must drop them, not double-journal
+    assert events.ingest(batch, worker="w1") == 0
+    assert len([e for e in events.events()
+                if e["kind"] == "fragment_requeue_busy"]) == 1
+    # a REMOTE worker's events (fresh eids) are journaled under its label
+    foreign = [{"eid": "feed-1", "ts": time.time(), "kind": "worker_evict",
+                "severity": "warn"}]
+    assert events.ingest(foreign, worker="w-remote") == 1
+    assert events.ingest(foreign, worker="w-remote") == 0   # retry dropped
+    got = [e for e in events.events() if e["eid"] == "feed-1"]
+    assert len(got) == 1 and got[0]["worker"] == "w-remote"
+
+
+def test_journal_prometheus_lines():
+    events.emit("worker_join", worker="w1")
+    events.emit("worker_join", worker="w2")
+    events.emit("admission_shed", severity="warn", qid="q")
+    lines = events.prometheus_lines()
+    assert "# TYPE igloo_events_total counter" in lines
+    assert 'igloo_events_total{kind="worker_join"} 2' in lines
+    assert 'igloo_events_total{kind="admission_shed"} 1' in lines
+
+
+# --- event-names lint checker ------------------------------------------
+
+
+def test_event_names_checker(tmp_path):
+    from igloo_tpu.lint import LintModule
+    from igloo_tpu.lint.event_names import EventNamesChecker
+    doc = tmp_path / "obs.md"
+    doc.write_text("### Event catalog\n\n| kind | meaning |\n|---|---|\n"
+                   "| `worker_join` | a worker joined |\n\n## Next\n")
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "from igloo_tpu.cluster import events\n"
+        "events.emit('worker_join', worker='w')\n"
+        "events.emit('not_cataloged')\n"
+        "kind = 'worker_join'\n"
+        "events.emit(kind)\n")
+    checker = EventNamesChecker(doc_path=doc)
+    mod = LintModule.parse(src, root=tmp_path)
+    list(checker.check(mod))
+    findings = sorted(checker.finalize([mod]), key=lambda f: f.line)
+    assert len(findings) == 2
+    assert "not_cataloged" in findings[0].message
+    assert "not a string literal" in findings[1].message
+
+
+def test_event_names_rule_in_default_lint():
+    from igloo_tpu.lint import default_checkers
+    assert "event-names" in {c.name for c in default_checkers()}
+
+
+# --- igloo top renderer ------------------------------------------------
+
+
+def test_render_top_smoke():
+    from igloo_tpu.cli import render_top
+    status = {
+        "window_s": 60.0, "qps": 2.5, "p50_ms": 4.0, "p99_ms": 31.0,
+        "serving": {"running": 1, "queued": 0},
+        "workers": [{"id": "w1", "addr": "grpc+tcp://127.0.0.1:9",
+                     "devices": 8, "slots": 2, "age_s": 0.4}],
+        "active": ["q7"],
+        "events": [{"ts": time.time(), "kind": "worker_join",
+                    "severity": "info", "worker": "w1",
+                    "attrs": {"devices": 8}}],
+        "samples": [{"gauges": {"serving.hbm_reserved_bytes": 1024.0,
+                                "serving.running": 1.0}, "rates": {}}],
+    }
+    text = render_top(status, coordinator="127.0.0.1:50051")
+    assert "igloo top — 127.0.0.1:50051" in text
+    assert "qps 2.5" in text and "p99 31 ms" in text
+    assert "w1" in text and "devices 8" in text
+    assert "worker_join" in text and "devices=8" in text
+    assert "serving.hbm_reserved_bytes 1024" in text
+    assert "q7" in text
+    # empty status must render, not crash (a cold coordinator)
+    assert "recent events" in render_top({})
+
+
+# --- IGLOO_WATCH=0 kill switch -----------------------------------------
+
+
+def test_watch_off_is_a_complete_noop(monkeypatch):
+    monkeypatch.setenv("IGLOO_WATCH", "0")
+    before = tracing.REGISTRY.counters()
+    timeseries.stop()
+    assert timeseries.start("t") is None
+    assert timeseries.samples() == []
+    assert events.emit("worker_join", worker="w") is None
+    assert events.events() == []
+    _warm("fp-off")            # direct store writes still work...
+    assert watch.check_query("fp-off", 99.0, qid="q-off") is None
+    assert watch.slow_queries() == []
+    # ...but check_query folded nothing in and bumped nothing
+    assert hints.watch_store().baseline("fp-off")["count"] == \
+        watch.MIN_OBSERVATIONS
+    after = tracing.REGISTRY.counters()
+    for name in ("watch.samples", "watch.slow_queries", "events.emitted",
+                 "trace.pinned"):
+        assert after.get(name, 0) == before.get(name, 0)
+
+
+def test_watch_off_results_bit_identical(monkeypatch):
+    import pyarrow as pa
+    from igloo_tpu.engine import QueryEngine
+    t = pa.table({"a": [1, 2, 3, 2], "b": [10.0, 20.0, 30.0, 40.0]})
+    sql = "SELECT a, SUM(b) AS s FROM t GROUP BY a ORDER BY a"
+
+    def run():
+        eng = QueryEngine(use_jit=False)
+        eng.register_table("t", t)
+        return eng.execute(sql)
+
+    on = run()
+    monkeypatch.setenv("IGLOO_WATCH", "0")
+    off = run()
+    assert on.equals(off)
+
+
+# --- system tables -----------------------------------------------------
+
+
+def test_watchtower_system_tables():
+    import pyarrow as pa
+    from igloo_tpu.engine import QueryEngine
+    events.emit("worker_join", worker="w1")
+    _warm("fp-sys")
+    watch.check_query("fp-sys", 3.0, qid="q-sys")
+    eng = QueryEngine(use_jit=False)
+    eng.register_table("t", pa.table({"a": [1]}))
+    ev = eng.execute("SELECT kind, worker FROM system.cluster_events")
+    assert ("worker_join", "w1") in zip(
+        ev.column("kind").to_pylist(), ev.column("worker").to_pylist())
+    sq = eng.execute("SELECT qid, factor FROM system.slow_queries")
+    assert sq.column("qid").to_pylist() == ["q-sys"]
+    assert sq.column("factor").to_pylist()[0] == pytest.approx(3.0 / 0.01)
