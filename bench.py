@@ -255,8 +255,8 @@ def bench_block(sf: float, queries: list, trials: int,
         for k in ("grace_partitions", "grace_pipeline", "counters",
                   "warm_h2d_bytes", "peak_hbm_bytes", "shuffle_buckets",
                   "exchange_bytes", "compile_cache_hits",
-                  "compile_cache_misses", "adaptive", "pallas", "topology",
-                  "oversized"):
+                  "compile_cache_misses", "adaptive", "pallas", "autotune",
+                  "topology", "oversized"):
             if k in rec:
                 block["queries"][q][k] = rec[k]
         if "oversized" in block["queries"][q]:

@@ -40,7 +40,8 @@ from igloo_tpu.bench.runner import make_engine  # shared staging helper
 _CONVERGENCE_COUNTERS = ("jit.miss", "fused.compact_repair",
                          "join.speculation_overflow",
                          "join.direct_dup_fallback",
-                         "pallas.probe_overflow", "pallas.agg_overflow")
+                         "pallas.probe_overflow", "pallas.agg_overflow",
+                         "pallas.match_overflow")
 
 # packed-key fast-path adoption counters (exec/kernels.py planners via the
 # executor/fused compilers): any delta across a query's runs means the
@@ -56,13 +57,14 @@ _PACK_COUNTERS = ("pack.agg", "pack.sort", "pack.semi")
 _DELTA_PREFIXES = ("jit.", "pack.", "grace.", "chunked.", "xfer.",
                    "cache.", "result_cache.", "engine.", "fused.", "join.",
                    "exchange.", "compile_cache.", "adaptive.", "pallas.",
-                   "mesh.", "codec.")
+                   "mesh.", "codec.", "autotune.", "topk.")
 
 # Pallas kernel names whose dispatch counters feed the per-query `pallas`
 # block (docs/kernels.md); fallback/overflow counters are summed beside
 # them so an A/B against IGLOO_TPU_PALLAS=0 is attributable per query
-_PALLAS_KERNELS = ("probe", "segagg", "gather")
-_PALLAS_FALLBACKS = ("pallas.probe_overflow", "pallas.agg_overflow")
+_PALLAS_KERNELS = ("probe", "segagg", "gather", "scatter", "match", "topk")
+_PALLAS_FALLBACKS = ("pallas.probe_overflow", "pallas.agg_overflow",
+                     "pallas.match_overflow")
 
 
 def _pallas_enabled() -> bool:
@@ -177,6 +179,19 @@ def run_query(engine, sql: str, trials: int, hbm_budget: int = 0) -> dict:
         "kernels_used": [k for k in _PALLAS_KERNELS
                          if query_delta.get(f"pallas.{k}") > 0],
         "fallbacks": fallbacks,
+    }
+    # per-shape autotuner record (docs/kernels.md#autotuner): which table
+    # version the dispatch planners consulted and whether tuned winners —
+    # not module defaults — shaped this query's kernels; the record that
+    # makes a tuned-vs-default A/B (IGLOO_TPU_AUTOTUNE=0) attributable
+    from igloo_tpu.exec import autotune
+    rec["autotune"] = {
+        "mode": autotune.mode(),
+        "table_version": autotune.table_version(),
+        "hits": query_delta.get("autotune.hit"),
+        "misses": query_delta.get("autotune.miss"),
+        "swept": query_delta.get("autotune.sweep"),
+        "tuned": query_delta.get("autotune.hit") > 0,
     }
     # two-level topology block (docs/distributed.md): which level(s) of
     # parallelism this query's execution actually used. A sweep worker is one

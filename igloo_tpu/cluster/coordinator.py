@@ -1416,6 +1416,9 @@ class CoordinatorServer(flight.FlightServerBase):
         if action.type == "compile_cache_put":
             # worker pushing a freshly compiled entry back to the cluster
             from igloo_tpu import compile_cache
+            from igloo_tpu.exec import autotune  # noqa: F401 -- the import
+            # registers the tuning-table merge hook, so a pushed
+            # autotune.json merges instead of first-writer-wins
             put = protocol.COMPILE_CACHE_PUT.parse(req)
             stored = compile_cache.write_entry(
                 put["name"], compile_cache.decode_entry(put["data"]))

@@ -63,11 +63,14 @@ TOMBSTONE_CAP = 4096
 # --- deterministic key hashing ----------------------------------------------
 
 
-def _hash_column(col, typ) -> np.ndarray:
-    """uint64 hash lane for one key column (process-independent: strings hash
-    their bytes via native hash64.c, numerics hash a canonical int64/bit
-    pattern). Nulls hash as 0 — they only need a consistent ROUTE, equality
-    semantics stay with the join that consumes the bucket."""
+def _column_vals(col, typ) -> np.ndarray:
+    """Canonical pre-mix uint64 lane for one key column (process-independent:
+    strings hash their bytes via native hash64.c, numerics use a canonical
+    int64/bit pattern). Nulls read as 0 — they only need a consistent ROUTE,
+    equality semantics stay with the join that consumes the bucket. The
+    per-column avalanche (multiply + shift-xor) happens downstream so the
+    Pallas exchange-scatter kernel can consume these same lanes and stay
+    bit-identical to the numpy mix (exec/pallas_kernels.py hash_scatter)."""
     import pyarrow.compute as pc
 
     from igloo_tpu.exec.batch import hash64_bytes
@@ -78,20 +81,24 @@ def _hash_column(col, typ) -> np.ndarray:
         dvals = np.asarray(col.dictionary.to_numpy(zero_copy_only=False),
                            dtype=object)
         ids = np.asarray(pc.fill_null(col.indices, 0)).astype(np.int64)
-        vals = hash64_bytes(dvals, seed=0)[ids] if len(dvals) else \
+        return hash64_bytes(dvals, seed=0)[ids] if len(dvals) else \
             np.zeros(len(col), dtype=np.uint64)
-    elif pa.types.is_floating(typ):
+    if pa.types.is_floating(typ):
         v = np.asarray(col.cast(pa.float64()).fill_null(0.0),
                        dtype=np.float64)
         # canonicalize -0.0 -> +0.0 and NaN -> one bit pattern so equal keys
         # (SQL equality) always share a bucket
         v = v + 0.0
         v = np.where(np.isnan(v), np.float64(0.0), v)
-        vals = v.view(np.uint64)
-    else:
-        if pa.types.is_date32(typ):
-            col = col.cast(pa.int32())
-        vals = np.asarray(col.cast(pa.int64()).fill_null(0)).astype(np.uint64)
+        return v.view(np.uint64)
+    if pa.types.is_date32(typ):
+        col = col.cast(pa.int32())
+    return np.asarray(col.cast(pa.int64()).fill_null(0)).astype(np.uint64)
+
+
+def _hash_column(col, typ) -> np.ndarray:
+    """uint64 hash lane for one key column: canonical value + avalanche."""
+    vals = _column_vals(col, typ)
     h = vals * _GOLDEN
     return h ^ (h >> np.uint64(29))
 
@@ -115,6 +122,50 @@ def bucket_ids(table: pa.Table, key_indices: list[int],
     of the low bits local join sorts use)."""
     h = key_hash(table, key_indices)
     return ((h >> np.uint64(17)) % np.uint64(nbuckets)).astype(np.int64)
+
+
+# partition shapes whose Pallas scatter program failed to lower this process
+# (keyed by the plan's canonical (npad, nbuckets) — a host decision, so the
+# retry recompiles straight on the numpy path)
+_SCATTER_BANS: set = set()
+
+
+def _partition_arrays(table: pa.Table, key_indices: list[int],
+                      nbuckets: int):
+    """(bucket ids, stable order or None, unsalted counts or None) for a hash
+    partition. Routes through the Pallas exchange-scatter kernel when
+    dispatch plans it — per-key avalanche + combine + bucket counts fused in
+    one device pass over the canonical lanes, bit-identical to `bucket_ids`
+    (docs/kernels.md) — and falls back to the numpy mix otherwise (kernels
+    off, shapes out of range, no keys, or a prior lowering failure)."""
+    if key_indices:
+        try:
+            from igloo_tpu.exec import dispatch
+            plan = dispatch.plan_scatter(
+                table.num_rows, len(key_indices), nbuckets,
+                banned=_ban_key(table.num_rows, nbuckets) in _SCATTER_BANS)
+        except Exception:
+            plan = None
+        if plan is not None:
+            lanes = []
+            for i in key_indices:
+                col = table.column(i)
+                col = col.combine_chunks() \
+                    if isinstance(col, pa.ChunkedArray) else col
+                lanes.append(_column_vals(col, table.schema.field(i).type))
+            try:
+                return dispatch.exchange_scatter(plan, lanes)
+            except Exception:
+                # compile-failure rung: ban this shape class and take the
+                # numpy path (mirrors the executor's per-kernel rung)
+                _SCATTER_BANS.add((plan[1], plan[2]))
+                tracing.counter("pallas.compile_fallback")
+    return bucket_ids(table, key_indices, nbuckets), None, None
+
+
+def _ban_key(nrows: int, nbuckets: int):
+    from igloo_tpu.exec.capacity import canonical_capacity
+    return (canonical_capacity(nrows), nbuckets)
 
 
 def partition_table(table: pa.Table, key_indices: list[int],
@@ -158,13 +209,16 @@ def salted_partition(table: pa.Table, key_indices: list[int], nbuckets: int,
     if table.num_rows == 0:
         return ([table.slice(0, 0) for _ in range(total)],
                 np.zeros(nbuckets, dtype=np.int64))
-    pid = bucket_ids(table, key_indices, nbuckets)
-    base_counts = np.bincount(pid, minlength=nbuckets).astype(np.int64)
+    pid, dev_order, dev_counts = _partition_arrays(table, key_indices,
+                                                   nbuckets)
+    base_counts = (dev_counts if dev_counts is not None else
+                   np.bincount(pid, minlength=nbuckets)).astype(np.int64)
     if extra and role == "probe":
         idx = np.nonzero(pid == hot)[0]
         r = np.arange(len(idx)) % (extra + 1)
         pid = pid.copy()
         pid[idx[r > 0]] = nbuckets + r[r > 0] - 1
+        dev_order = None  # salt rewrote the bucket lane: reorder on host
         tracing.counter("exchange.salted")
         tracing.counter("exchange.salted_rows", len(idx))
     elif extra and role == "build":
@@ -175,9 +229,11 @@ def salted_partition(table: pa.Table, key_indices: list[int], nbuckets: int,
             [pid] + [np.full(len(rep), nbuckets + j, dtype=pid.dtype)
                      for j in range(extra)])
         table = table.take(take)
+        dev_order = None  # replication lengthened the lane
         tracing.counter("exchange.salted")
         tracing.counter("exchange.salted_rows", len(rep) * extra)
-    order = np.argsort(pid, kind="stable")
+    order = dev_order if dev_order is not None \
+        else np.argsort(pid, kind="stable")
     sorted_tbl = table.take(order)
     counts = np.bincount(pid, minlength=total)
     out, off = [], 0

@@ -1,0 +1,350 @@
+"""Per-shape Pallas kernel autotuner (ROADMAP item 1, PR19).
+
+The dispatch planners (exec/dispatch.py) choose block/table shapes from
+module-level defaults — one window, one block size, one bucket shift for
+every capacity. The way "Ragged Paged Attention" kernels ship per-shape
+tuning tables (PAPERS.md), this module sweeps a small candidate grid per
+(kernel, canonical capacity) pair, benchmarks each candidate on synthetic
+lanes, and persists the winners in a JSON tuning table beside the XLA
+compile cache (the `_JsonStore` idiom, exec/hints.py):
+
+    {"version": 3,
+     "entries": {"probe/65536":  {"window": 16, "block": 1024,
+                                  "bucket_shift": 3},
+                 "segagg/65536": {"ways": 8, "block": 1024},
+                 "scatter/65536": {"block": 1024}, ...}}
+
+Keys are ``<kernel>/<canonical capacity>`` — capacities are family members
+(exec/capacity.py), so the table stays as small as the engine's shape
+vocabulary. ``dispatch.cache_token()`` folds ``table_version()`` into every
+jit cache key: adopting new winners (a local sweep OR a cluster-replicated
+table) bumps the version and can never serve a trace planned under the old
+shapes.
+
+Knobs:
+  ``IGLOO_TPU_AUTOTUNE``   0 = off (module defaults, version 0) | auto
+                           (default: consult persisted winners; never sweep
+                           inline) | sweep (benchmark candidates at first
+                           real use of a (kernel, capacity) pair)
+  ``IGLOO_AUTOTUNE_TABLE`` explicit table path (tests / shared clusters);
+                           default: ``autotune.json`` beside the XLA cache,
+                           in-memory only when no cache dir is configured.
+
+Cluster replication rides the EXISTING compile-cache transfer: the table
+file lives beside the cache entries, so workers pull it at registration and
+push it on heartbeats through the same ``compile_cache_get``/``put`` Flight
+actions. The one twist is that the table is MUTABLE — two sides may hold
+different versions — so this module registers a merge hook with
+``compile_cache.write_entry``: incoming bytes are merged entry-wise (the
+higher-version side wins), and adoption resets the process singleton so the
+next ``cache_token()`` sees the new version.
+
+Access policy: this module and ``exec/dispatch.py`` are the ONLY legal
+importers of ``pallas_kernels`` (igloo-lint ``pallas-dispatch`` rule) — the
+sweep benchmarks candidates by invoking the kernels directly, outside the
+dispatch ladder, on synthetic lanes that never touch query data.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from igloo_tpu.exec.hints import _JsonStore
+from igloo_tpu.utils import tracing
+
+# lock discipline (igloo-lint lock-discipline): the singleton is shared by
+# every executor and the Flight merge hook runs on RPC handler threads
+_GUARDED_BY = {"_lock": ("_data", "_dirty")}
+
+AUTOTUNE_ENV = "IGLOO_TPU_AUTOTUNE"
+TABLE_PATH_ENV = "IGLOO_AUTOTUNE_TABLE"
+
+#: the table's file name beside the XLA cache — also its compile-cache
+#: entry name on the wire (cluster/worker.py pull/push)
+TABLE_ENTRY = "autotune.json"
+
+#: candidate grids per kernel: small on purpose — every candidate costs a
+#: benchmark run, and the winning shapes plug into the planners' eligibility
+#: clamps unchanged (a tuned block is still forced through pow2_block)
+CANDIDATES = {
+    "probe": [{"window": w, "block": b, "bucket_shift": s}
+              for w in (8, 16, 32) for b in (512, 1024) for s in (2, 3)],
+    "segagg": [{"ways": w, "block": b}
+               for w in (4, 8, 16) for b in (512, 1024)],
+    "scatter": [{"block": b} for b in (256, 1024, 4096)],
+    "match": [{"window": w, "block": b}
+              for w in (8, 16, 32) for b in (512, 1024)],
+    "topk": [{"block": b} for b in (512, 1024, 2048)],
+}
+
+#: timed repetitions per candidate (plus one warmup/compile run)
+_BENCH_REPS = 2
+
+
+def mode() -> str:
+    """Normalized ``IGLOO_TPU_AUTOTUNE``: 0 | auto | sweep."""
+    raw = os.environ.get(AUTOTUNE_ENV, "auto").strip().lower()
+    return raw if raw in ("0", "sweep") else "auto"
+
+
+class TuningTable(_JsonStore):
+    """{"version": int, "entries": {"<kernel>/<cap>": {param: int}}} with
+    the `_JsonStore` atomic-flush/never-fail contract. The version bumps on
+    every local winner adoption and on every merge that changed anything —
+    it exists solely to flip ``dispatch.cache_token()``."""
+
+    def _coerce(self, raw: dict) -> dict:
+        entries = {}
+        for k, v in raw.get("entries", {}).items():
+            if isinstance(k, str) and isinstance(v, dict):
+                entries[k] = {p: int(x) for p, x in v.items()
+                              if isinstance(x, (int, float))}
+        return {"version": int(raw.get("version", 0)), "entries": entries}
+
+    def version(self) -> int:
+        with self._lock:
+            return int(self._data.get("version", 0))
+
+    def lookup(self, kernel: str, cap: int) -> Optional[dict]:
+        with self._lock:
+            rec = self._data.get("entries", {}).get(f"{kernel}/{int(cap)}")
+            return dict(rec) if rec is not None else None
+
+    def record(self, kernel: str, cap: int, params: dict) -> None:
+        clean = {p: int(x) for p, x in params.items()}
+        with self._lock:
+            entries = self._data.setdefault("entries", {})
+            key = f"{kernel}/{int(cap)}"
+            if entries.get(key) != clean:
+                entries[key] = clean
+                self._data["version"] = int(self._data.get("version", 0)) + 1
+                self._dirty = True
+        self.flush()
+
+    def merge_raw(self, raw: dict) -> bool:
+        """Adopt a remote table: entry-wise, the higher-version side wins on
+        conflicts; the merged version is max(local, remote), +1 when the
+        merge changed local entries (so BOTH sides converge to a version at
+        least as new as either input). Returns True when anything changed."""
+        other = self._coerce(raw if isinstance(raw, dict) else {})
+        with self._lock:
+            ours = int(self._data.get("version", 0))
+            theirs = other["version"]
+            entries = self._data.setdefault("entries", {})
+            changed = False
+            for k, v in other["entries"].items():
+                if k not in entries or (theirs > ours and entries[k] != v):
+                    if entries.get(k) != v:
+                        entries[k] = v
+                        changed = True
+            if changed:
+                self._data["version"] = max(ours, theirs) + 1
+                self._dirty = True
+            elif theirs > ours:
+                self._data["version"] = theirs
+                self._dirty = True
+                changed = True
+        if changed:
+            self.flush()
+        return changed
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return json.loads(json.dumps(
+                {"version": self._data.get("version", 0),
+                 "entries": self._data.get("entries", {})}))
+
+
+_singleton_lock = threading.Lock()
+_singleton: Optional[TuningTable] = None
+
+
+def table() -> TuningTable:
+    """Process-wide tuning table. Path precedence mirrors
+    ``hints.adaptive_store()``: IGLOO_AUTOTUNE_TABLE env > beside the
+    persistent XLA cache > in-memory only."""
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            path = os.environ.get(TABLE_PATH_ENV)
+            if path is None:
+                from igloo_tpu import compile_cache
+                cache_dir = compile_cache.active_dir()
+                if cache_dir:
+                    path = os.path.join(cache_dir, TABLE_ENTRY)
+            _singleton = TuningTable(path or None)
+        return _singleton
+
+
+def reset_table() -> None:
+    """Drop the process singleton (tests re-point IGLOO_AUTOTUNE_TABLE; the
+    compile-cache merge hook re-reads an updated file)."""
+    global _singleton
+    with _singleton_lock:
+        _singleton = None
+
+
+def table_version() -> int:
+    """The component ``dispatch.cache_token()`` folds into every jit key —
+    0 whenever autotuning is off (plans then never read the table)."""
+    if mode() == "0":
+        return 0
+    return table().version()
+
+
+def shapes(kernel: str, cap: int) -> dict:
+    """Tuned shape overrides for (kernel, canonical capacity) — {} when off
+    or untuned (module defaults apply). In sweep mode, a miss for a swept
+    kernel benchmarks the candidate grid right here (first real use) and
+    persists the winner."""
+    if mode() == "0":
+        return {}
+    t = table()
+    rec = t.lookup(kernel, cap)
+    if rec is not None:
+        tracing.counter("autotune.hit")
+        return rec
+    if mode() == "sweep" and kernel in CANDIDATES:
+        rec = sweep(kernel, cap)
+        if rec is not None:
+            return rec
+    tracing.counter("autotune.miss")
+    return {}
+
+
+# --- candidate benchmarking -------------------------------------------------
+
+
+def _bench_candidate(kernel: str, cap: int, params: dict) -> Optional[float]:
+    """Wall seconds for one candidate on synthetic lanes at `cap`, or None
+    when the candidate cannot run (shape ineligibility, compile failure).
+    Kernels run exactly as dispatch would invoke them — interpret mode off
+    TPU — on deterministic synthetic data sized like a real operand set."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from igloo_tpu.exec import dispatch, pallas_kernels
+    from igloo_tpu.exec.capacity import pow2_block
+
+    on, interp = dispatch.kernel_state()
+    if not on:
+        return None
+    rng = np.random.default_rng(cap ^ 0x5EED)
+
+    def timed(fn):
+        try:
+            jax.block_until_ready(fn())  # warmup / compile
+            t0 = time.perf_counter()
+            for _ in range(_BENCH_REPS):
+                jax.block_until_ready(fn())
+            return (time.perf_counter() - t0) / _BENCH_REPS
+        except Exception:
+            return None
+
+    if kernel == "probe":
+        block = pow2_block(cap, int(params["block"]))
+        window = int(params["window"])
+        nbuckets = min(max(cap >> int(params["bucket_shift"]), 8),
+                       dispatch.PROBE_MAX_BUCKETS)
+        build = jnp.asarray(np.sort(rng.integers(0, cap, cap)).astype(np.int64))
+        probe = jnp.asarray(rng.integers(0, cap, cap).astype(np.int64))
+        return timed(lambda: pallas_kernels.hash_probe_bounds(
+            build, probe, nbuckets, window, block, interp))
+    if kernel == "segagg":
+        ways = int(params["ways"])
+        block = pow2_block(cap, int(params["block"]))
+        nbuckets = max(min(cap * ways, dispatch.DIRECT_SEG_SMALL_LIMIT)
+                       // ways, 8)
+        packed = jnp.asarray(rng.integers(0, max(cap // 8, 2), cap)
+                             .astype(np.int64))
+        live = jnp.ones((cap,), bool)
+        vals = jnp.asarray(rng.integers(0, 1000, cap).astype(np.int64))
+        return timed(lambda: pallas_kernels.hash_segagg(
+            packed, live, ("sum",), [live, vals], nbuckets, ways, block,
+            interp))
+    if kernel == "scatter":
+        block = pow2_block(cap, int(params["block"]))
+        lanes = [jnp.asarray(rng.integers(0, 1 << 62, cap, dtype=np.int64)
+                             .astype(np.uint64)) for _ in range(2)]
+        live = jnp.ones((cap,), bool)
+        return timed(lambda: pallas_kernels.hash_scatter(
+            lanes, live, 64, block, interp))
+    if kernel == "match":
+        window = int(params["window"])
+        block = pow2_block(cap, int(params["block"]))
+        counts = rng.integers(0, 3, cap).astype(np.int32)
+        prefix = np.cumsum(counts) - counts
+        return timed(lambda: pallas_kernels.match_owner_table(
+            jnp.asarray(prefix.astype(np.int64)), jnp.asarray(counts), cap,
+            window, block, interp))
+    if kernel == "topk":
+        block = pow2_block(cap, int(params["block"]))
+        k = min(64, block)
+        keys = jnp.asarray(rng.integers(0, 1 << 40, cap).astype(np.int64))
+        return timed(lambda: pallas_kernels.blocked_topk(
+            keys, k, block, interp))
+    return None
+
+
+def sweep(kernel: str, cap: int) -> Optional[dict]:
+    """Benchmark the candidate grid for (kernel, cap), persist the winner,
+    and return its params (None when no candidate ran)."""
+    tracing.counter("autotune.sweep")
+    best, best_t = None, None
+    for params in CANDIDATES.get(kernel, []):
+        t = _bench_candidate(kernel, cap, params)
+        if t is not None and (best_t is None or t < best_t):
+            best, best_t = params, t
+    if best is not None:
+        table().record(kernel, cap, best)
+    return best
+
+
+def sweep_offline(kernels=None, caps=None) -> dict:
+    """Offline sweep entry point (scripts/autotune_sweep.py): sweep every
+    (kernel, capacity) pair and return {key: {params, seconds}}."""
+    from igloo_tpu.exec.capacity import canonical_capacity, tuning_capacities
+    kernels = list(kernels or CANDIDATES)
+    caps = [canonical_capacity(c) for c in (caps or tuning_capacities())]
+    out = {}
+    for kern in kernels:
+        for cap in caps:
+            best = sweep(kern, cap)
+            if best is not None:
+                out[f"{kern}/{cap}"] = best
+    return out
+
+
+# --- cluster replication (compile-cache transfer merge hook) ----------------
+
+
+def _merge_entry(existing: Optional[bytes], incoming: bytes) -> bytes:
+    """compile_cache.write_entry hook for the table's entry: merge instead
+    of first-writer-wins (the table is the one MUTABLE entry beside the
+    immutable XLA programs)."""
+    try:
+        raw = json.loads(incoming.decode())
+    except Exception:
+        return existing if existing is not None else incoming
+    t = table()
+    t.merge_raw(raw)
+    return json.dumps(t.snapshot()).encode()
+
+
+def _on_adopted() -> None:
+    """After the merged file lands: drop the singleton so the next
+    ``table_version()`` (and therefore ``dispatch.cache_token()``) reads the
+    adopted table."""
+    reset_table()
+
+
+def register_with_compile_cache() -> None:
+    from igloo_tpu import compile_cache
+    compile_cache.register_merge(TABLE_ENTRY, _merge_entry, _on_adopted)
+
+
+register_with_compile_cache()

@@ -131,6 +131,16 @@ def pow2_block(n: int, cap: int) -> int:
     return b
 
 
+def tuning_capacities(limit: int = COARSE_FLOOR) -> list:
+    """Representative family members for offline kernel sweeps
+    (exec/autotune.py, scripts/autotune_sweep.py): every member from a
+    quarter of the small-band ceiling up to `limit` — the shapes real
+    operand sets quantize to. Smaller capacities are skipped on purpose:
+    kernels there finish too fast for block/window choice to matter, and
+    every swept capacity costs a full candidate-grid benchmark."""
+    return [c for c in capacity_family(limit) if c >= COARSE_FLOOR // 4]
+
+
 def canonical_direct_table(lo: int, hi: int) -> tuple:
     """Canonical (base, table_size) for a direct-join positional table over
     key bounds [lo, hi]. The raw bounds are data-dependent constants; baking

@@ -92,6 +92,32 @@ GATHER_BLOCK = 1024
 #: fusing fewer lanes than this is not worth a kernel launch
 GATHER_MIN_COLS = 2
 
+#: match materialization: per-probe-row output window (the probe window's
+#: twin — a row's match count is bounded by its probe run, so the same
+#: default never overflows when the probe kernel didn't)
+MATCH_WINDOW = 16
+MATCH_BLOCK = 1024
+#: the owner table is match-capacity-resident (int32): interpret / compiled
+#: VMEM clamps, PROBE_MAX_BUILD's rationale
+MATCH_MAX_CAP = 1 << 22
+MATCH_MAX_CAP_COMPILED = 1 << 20
+
+#: blocked partial top-k: per-block selection is k static min/mask rounds,
+#: so k stays small (LIMIT + OFFSET; every TPC-H LIMIT is <= 100)
+TOPK_MAX_K = 128
+TOPK_BLOCK = 1024
+TOPK_MAX_ROWS = 1 << 22
+TOPK_MAX_ROWS_COMPILED = 1 << 20
+
+#: exchange hash + partition scatter: padded row clamp (lanes are padded to
+#: the canonical capacity family so kernel programs stay family-keyed),
+#: bucket histogram residency, and the key-column fan-in
+SCATTER_BLOCK = 1024
+SCATTER_MAX_ROWS = 1 << 22
+SCATTER_MAX_ROWS_COMPILED = 1 << 20
+SCATTER_MAX_BUCKETS = 1 << 16
+SCATTER_MAX_COLS = 8
+
 
 def mode() -> str:
     """Normalized ``IGLOO_TPU_PALLAS``: auto | 0 | 1 | interpret."""
@@ -128,8 +154,19 @@ def enabled() -> bool:
 def cache_token() -> tuple:
     """Rides every jit cache key (Executor._jitted, the fused program key)
     so flipping IGLOO_TPU_PALLAS mid-process can never serve a program
-    traced under the other mode."""
-    return ("pallas",) + kernel_state()
+    traced under the other mode. The autotune table version rides along for
+    the same reason: adopting new tuned shapes (locally or via cluster
+    replication) must re-trace every kernel-bearing program, never serve a
+    trace planned under the old shapes."""
+    from igloo_tpu.exec import autotune
+    return ("pallas",) + kernel_state() + (autotune.table_version(),)
+
+
+def _tuned(kernel: str, cap: int) -> dict:
+    """Autotuned shape overrides for (kernel, canonical capacity) — {} when
+    autotuning is off or no winner is persisted (module defaults apply)."""
+    from igloo_tpu.exec import autotune
+    return autotune.shapes(kernel, cap)
 
 
 def _fallback(kernel: str, reason: str) -> None:
@@ -150,11 +187,14 @@ def plan_probe(build_cap: int, probe_cap: int,
         return _fallback("probe", "banned")
     if build_cap > (PROBE_MAX_BUILD if interp else PROBE_MAX_BUILD_COMPILED):
         return _fallback("probe", "too_big")
-    nbuckets = min(max(canonical_capacity(build_cap) >> PROBE_BUCKET_SHIFT, 8),
+    tuned = _tuned("probe", canonical_capacity(build_cap))
+    shift = int(tuned.get("bucket_shift", PROBE_BUCKET_SHIFT))
+    nbuckets = min(max(canonical_capacity(build_cap) >> shift, 8),
                    PROBE_MAX_BUCKETS)
-    block = pow2_block(probe_cap, PROBE_BLOCK)
+    block = pow2_block(probe_cap, int(tuned.get("block", PROBE_BLOCK)))
     tracing.counter("pallas.probe")
-    return ("probe", nbuckets, PROBE_WINDOW, block, interp)
+    return ("probe", nbuckets, int(tuned.get("window", PROBE_WINDOW)),
+            block, interp)
 
 
 def plan_segagg(pack_spec, n_keys: int, input_cap: int,
@@ -172,13 +212,15 @@ def plan_segagg(pack_spec, n_keys: int, input_cap: int,
         return _fallback("segagg", "unpackable")
     # 8x headroom over the input capacity keeps the per-bucket occupancy
     # low enough that `ways` slots rarely exhaust (overflow falls back)
-    table = min(canonical_capacity(input_cap) * AGG_WAYS,
+    tuned = _tuned("segagg", canonical_capacity(input_cap))
+    ways = int(tuned.get("ways", AGG_WAYS))
+    table = min(canonical_capacity(input_cap) * ways,
                 DIRECT_SEG_SMALL_LIMIT if interp
                 else AGG_TABLE_ROWS_COMPILED)
-    nbuckets = max(table // AGG_WAYS, 8)
-    block = pow2_block(input_cap, AGG_BLOCK)
+    nbuckets = max(table // ways, 8)
+    block = pow2_block(input_cap, int(tuned.get("block", AGG_BLOCK)))
     tracing.counter("pallas.segagg")
-    return ("segagg", nbuckets, AGG_WAYS, block, interp)
+    return ("segagg", nbuckets, ways, block, interp)
 
 
 def segagg_table_rows(plan: tuple) -> int:
@@ -239,3 +281,129 @@ def gather_columns(arrays: list, idx) -> list:
     from igloo_tpu.exec import pallas_kernels
     _, block, interp = plan
     return pallas_kernels.fused_gather(list(arrays), idx, block, interp)
+
+
+def plan_match(probe_cap: int, match_cap: int,
+               banned: bool = False) -> Optional[tuple]:
+    """Plan match materialization for ``join.expand_phase``: route "kernel"
+    (one blocked Pallas pass, bounded window, deferred overflow) when the
+    kernels are on and the shapes fit; route "search" (an exact searchsorted
+    inversion of the prefix lane — the algorithmic fast path the non-Pallas
+    tier keeps) otherwise. A ban (earlier overflow/compile failure) demotes
+    the kernel route to "search", never all the way to the scan."""
+    on, interp = kernel_state()
+    if on and not banned:
+        if match_cap <= (MATCH_MAX_CAP if interp else MATCH_MAX_CAP_COMPILED):
+            tuned = _tuned("match", canonical_capacity(match_cap))
+            block = pow2_block(probe_cap,
+                               int(tuned.get("block", MATCH_BLOCK)))
+            tracing.counter("pallas.match")
+            return ("match", "kernel",
+                    int(tuned.get("window", MATCH_WINDOW)), block, interp)
+        _fallback("match", "too_big")
+    elif on and banned:
+        _fallback("match", "banned")
+    if _backend() == "tpu" and not interp:
+        # on real TPU hardware the scatter+cummax scan beats a searchsorted
+        # over the match lane (a ~23-pass gather loop — see expand_phase)
+        return None
+    tracing.counter("join.match_search")
+    return ("match", "search")
+
+
+def plan_topk(cap: int, k: int, full_pack: bool,
+              banned: bool = False) -> Optional[tuple]:
+    """Plan a partial top-k for LIMIT-over-ORDER-BY, or None for the full
+    sort path. Mode-independent: route "alg" (``lax.top_k`` over the packed
+    sort key — ties are lowest-index-first, the stable argsort's first-k
+    order) is pure XLA and wins on every tier; route "pallas" is the blocked
+    kernel. `k` is LIMIT + OFFSET; `full_pack` means the prefix packing
+    covers EVERY sort key (a single totally-ordered lane — partial packs
+    still need the lexicographic tiebreak sort)."""
+    if k <= 0 or cap <= 0:
+        return None
+    if not full_pack:
+        return _fallback("topk", "unpackable")
+    if 2 * k > cap:
+        # LIMIT covers most of the batch: a partial top-k (and the packed
+        # prefix it rides on) buys nothing — take the direct sort path
+        return _fallback("topk", "large_limit")
+    on, interp = kernel_state()
+    if on and not banned and k <= TOPK_MAX_K and \
+            cap <= (TOPK_MAX_ROWS if interp else TOPK_MAX_ROWS_COMPILED):
+        tuned = _tuned("topk", canonical_capacity(cap))
+        block = pow2_block(cap, int(tuned.get("block", TOPK_BLOCK)))
+        if k <= block:
+            tracing.counter("pallas.topk")
+            return ("topk", "pallas", k, block, interp)
+    tracing.counter("topk.alg")
+    return ("topk", "alg", k)
+
+
+def plan_scatter(nrows: int, ncols: int, nbuckets: int,
+                 banned: bool = False) -> Optional[tuple]:
+    """Plan the fused exchange hash + partition scatter, or None for the
+    numpy path. `nrows` is the raw table length (lanes are padded to its
+    canonical capacity so programs stay family-keyed), `ncols` the key
+    fan-in, `nbuckets` the exchange bucket count."""
+    on, interp = kernel_state()
+    if not on or ncols == 0 or nrows == 0:
+        return None
+    if banned:
+        return _fallback("scatter", "banned")
+    npad = canonical_capacity(nrows)
+    if ncols > SCATTER_MAX_COLS or nbuckets > SCATTER_MAX_BUCKETS or \
+            npad > (SCATTER_MAX_ROWS if interp else SCATTER_MAX_ROWS_COMPILED):
+        return _fallback("scatter", "too_big")
+    tuned = _tuned("scatter", npad)
+    block = pow2_block(npad, int(tuned.get("block", SCATTER_BLOCK)))
+    tracing.counter("pallas.scatter")
+    return ("scatter", npad, nbuckets, block, interp)
+
+
+def match_table(plan: tuple, prefix, counts, match_cap: int):
+    """(owner, overflow) — the slot-ownership table ``join.expand_phase``
+    derives by owner-scatter + associative scan, via the Pallas match
+    kernel (route "kernel" plans only)."""
+    from igloo_tpu.exec import pallas_kernels
+    _, _, window, block, interp = plan
+    return pallas_kernels.match_owner_table(prefix, counts, match_cap,
+                                            window, block, interp)
+
+
+def topk_perm(plan: tuple, sort_key):
+    """Positions of the k smallest packed sort keys, in the full stable
+    ascending order's first-k sequence (ties lowest-position-first)."""
+    import jax
+    import jax.numpy as jnp
+    if plan[1] == "alg":
+        k = plan[2]
+        return jax.lax.top_k(-sort_key, k)[1].astype(jnp.int32)
+    from igloo_tpu.exec import pallas_kernels
+    _, _, k, block, interp = plan
+    ckeys, cpos = pallas_kernels.blocked_topk(sort_key, k, block, interp)
+    # candidates are block-major with position-ascending ties inside AND
+    # across blocks, so a stable argsort reproduces the global stable order
+    order = jnp.argsort(ckeys, stable=True)
+    return jnp.take(cpos, order[:k])
+
+
+def exchange_scatter(plan: tuple, val_lanes: list):
+    """(bucket_ids, order, counts) for an exchange partition — numpy arrays
+    bit-identical to ``exchange.bucket_ids`` + stable argsort + bincount.
+    `val_lanes` are the host-side canonical pre-mix uint64 lanes
+    (``exchange._column_vals``); padding to the canonical capacity and the
+    final stable sort of the bucket lane happen device-side."""
+    import jax.numpy as jnp
+    from igloo_tpu.exec import pallas_kernels
+    _, npad, nbuckets, block, interp = plan
+    n = int(val_lanes[0].shape[0])
+    pad = npad - n
+    lanes = [jnp.asarray(np.pad(v, (0, pad))) for v in val_lanes]
+    live = jnp.arange(npad) < n
+    pid_full, counts = pallas_kernels.hash_scatter(lanes, live, nbuckets,
+                                                   block, interp)
+    pid = pid_full[:n]
+    order = jnp.argsort(pid, stable=True)
+    return (np.asarray(pid).astype(np.int64), np.asarray(order),
+            np.asarray(counts))

@@ -44,7 +44,7 @@ from igloo_tpu.exec.join import (
     join_batches, make_key_hash_idxs, probe_phase,
 )
 from igloo_tpu.exec.fused import FusedCompiler, FusionUnsupported
-from igloo_tpu.exec.sort_limit import limit_batch, sort_batch
+from igloo_tpu.exec.sort_limit import limit_batch, sort_batch, topk_batch
 from igloo_tpu.plan import expr as E
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
@@ -223,6 +223,12 @@ class Executor:
         self._batch_cache = batch_cache  # Optional[BatchCache]
         self._speculate = speculate
         self._hints = hints  # Optional[HintStore] (persistent nhints)
+        # ORDER BY + LIMIT fusion handshake (staged tier): _exec_limit sets
+        # the hint before descending into its Sort child; _exec_sort consumes
+        # it (identity-matched on the plan node) when dispatch.plan_topk
+        # adopts, and raises _limit_taken so _exec_limit skips the mask pass
+        self._limit_hint: Optional[tuple] = None
+        self._limit_taken = False
         self._deferred_overflow: list = []  # device bools, checked at final fetch
         # (hint key, device int) pairs riding the SAME final fetch: observed
         # live counts that persist as capacity hints for the staged path's
@@ -319,6 +325,12 @@ class Executor:
             # table holds — sort path from now on
             self._cache[("nopallas_agg", tag[1])] = True
             tracing.counter("pallas.agg_overflow")
+        elif tag[0] == "pallas_match":
+            # match-materialization window overflow: some probe row owns a
+            # longer match run than the kernel's window — scan path from
+            # now on
+            self._cache[("nopallas_match", tag[1])] = True
+            tracing.counter("pallas.match_overflow")
 
     def _fired_deferred(self, deferred, vals) -> list:
         """Check fetched deferred-flag values; returns the fired tags (empty
@@ -340,7 +352,7 @@ class Executor:
         repair run pay a count sync per join for nothing. (The sharded
         tier never plans Pallas kernels, so its _exact_copy override is
         always the path taken there.)"""
-        if any(t[0] not in ("pallas_probe", "pallas_agg")
+        if any(t[0] not in ("pallas_probe", "pallas_agg", "pallas_match")
                for t in fired_tags):
             return self._exact_copy()
         return Executor(self._cache, use_jit=self._use_jit,
@@ -1190,11 +1202,15 @@ class Executor:
                 "join_probe", (fpbase, pp),
                 lambda: (lambda l, r, consts: probe_phase(
                     l, r, use_lk, use_rk, lhx, rhx, consts, probe_plan=pp)))
-        expand = self._jitted(
-            "join_expand", (fpbase, plan.schema),
-            lambda: (lambda l, r, p, match_cap, consts: expand_phase(
-                l, r, p, match_cap, jt, residual, plan.schema, consts)),
-            static_argnums=(3,))
+        def expand_fn(mp):
+            # the match plan rides the expand program's cache key (same rule
+            # as the probe plan above: host decisions key the trace)
+            return self._jitted(
+                "join_expand", (fpbase, plan.schema, mp),
+                lambda: (lambda l, r, p, match_cap, consts: expand_phase(
+                    l, r, p, match_cap, jt, residual, plan.schema, consts,
+                    match_plan=mp)),
+                static_argnums=(3,))
 
         try:
             p = probe_fn(pplan)(ls, rs, consts)
@@ -1222,7 +1238,30 @@ class Executor:
         else:
             total = int(p.total)  # the one host sync
             match_cap = choose_match_capacity(total)
-        out = expand(ls, rs, p, match_cap, consts)
+        # Pallas match-materialization dispatch (docs/kernels.md): replaces
+        # the owner-scatter + associative-scan chain inside expand_phase;
+        # window overflow rides the deferred protocol like the probe kernel
+        mplan = dispatch.plan_match(
+            left.capacity, match_cap,
+            banned=bool(self._cache.get(("nopallas_match", jfp_core))))
+        try:
+            res = expand_fn(mplan)(ls, rs, p, match_cap, consts)
+        except Exception:
+            if mplan is None or mplan[1] != "kernel":
+                raise
+            self._cache[("nopallas_match", jfp_core)] = True
+            tracing.counter("pallas.compile_fallback")
+            mplan = dispatch.plan_match(left.capacity, match_cap, banned=True)
+            res = expand_fn(mplan)(ls, rs, p, match_cap, consts)
+        if mplan is not None:
+            out, movf = res
+            if mplan[1] == "kernel":
+                stats.annotate(
+                    pallas="probe+match" if pplan is not None else "match")
+                self._deferred_overflow.append((("pallas_match", jfp_core),
+                                                movf))
+        else:
+            out = res
         out = attach_dicts(out, dicts[: len(out.columns)],
                            bnds[: len(out.columns)])
         if total is None:
@@ -1266,6 +1305,52 @@ class Executor:
                                      comp.pool)
         if pack is not None:
             tracing.counter("pack.sort")
+        hint = self._limit_hint
+        if hint is not None and hint[0] == id(plan):
+            # ORDER BY + LIMIT fusion: the parent Limit deposited its bounds
+            # before descending; adopt a partial top-k when the plan fits
+            # (full pack required — one lane totally orders the rows)
+            self._limit_hint = None
+            _, limit, offset = hint
+            k_total = limit + offset
+            fp_core = (expr_fingerprint(res), tuple(plan.ascending),
+                       tuple(plan.nulls_first), batch_proto_key(batch),
+                       comp.pool.signature(), tuple(comp.marks), pack)
+            # ban key uses the FUSED compiler's topk core format so a fused
+            # compile failure's ban is visible here and vice versa
+            tfp_core = ("|".join(repr(e) for e in res),
+                        tuple(plan.ascending), tuple(plan.nulls_first))
+            tplan = dispatch.plan_topk(
+                batch.capacity, k_total,
+                pack is not None and pack[1] == len(keys),
+                banned=bool(self._cache.get(("nopallas_topk", tfp_core))))
+            if tplan is not None:
+                out_cap = round_capacity(k_total)
+
+                def tbuild(tp):
+                    def mk():
+                        def fn(b, consts):
+                            return topk_batch(b, keys, consts, pack, tp,
+                                              limit, offset, out_cap)
+                        return fn
+                    return self._jitted("topk", ("topk", fp_core, tp,
+                                                 limit, offset, out_cap), mk)
+                try:
+                    out = tbuild(tplan)(strip_dicts(batch),
+                                        comp.pool.device_args())
+                except Exception:
+                    if tplan[1] != "pallas":
+                        raise
+                    self._cache[("nopallas_topk", tfp_core)] = True
+                    tracing.counter("pallas.compile_fallback")
+                    tplan = dispatch.plan_topk(
+                        batch.capacity, k_total,
+                        pack is not None and pack[1] == len(keys),
+                        banned=True)
+                    out = tbuild(tplan)(strip_dicts(batch),
+                                        comp.pool.device_args())
+                self._limit_taken = True
+                return attach_dicts(out, *col_meta(batch.columns))
         fp = ("sort", expr_fingerprint(res), tuple(plan.ascending),
               tuple(plan.nulls_first), batch_proto_key(batch),
               comp.pool.signature(), tuple(comp.marks), pack)
@@ -1280,7 +1365,22 @@ class Executor:
         return attach_dicts(out, *col_meta(batch.columns))
 
     def _exec_limit(self, plan: L.Limit) -> DeviceBatch:
-        batch = self._exec(plan.input)
+        if isinstance(plan.input, L.Sort) and plan.limit is not None:
+            # deposit the LIMIT bounds for the Sort child (identity-matched
+            # there, so an intervening rewrite can never mis-adopt); when the
+            # child took the top-k its output already IS the limited batch
+            prev = self._limit_hint
+            self._limit_hint = (id(plan.input), plan.limit, plan.offset)
+            self._limit_taken = False
+            try:
+                batch = self._exec(plan.input)
+            finally:
+                self._limit_hint = prev
+            if self._limit_taken:
+                self._limit_taken = False
+                return self._maybe_shrink(batch, known_live=plan.limit)
+        else:
+            batch = self._exec(plan.input)
         fp = ("limit", plan.limit, plan.offset, batch_proto_key(batch))
 
         def build():

@@ -54,7 +54,7 @@ from igloo_tpu.exec.join import (
     choose_direct_build, direct_join_phase, direct_probe, expand_phase,
     make_key_hash_idxs, probe_phase,
 )
-from igloo_tpu.exec.sort_limit import limit_batch, sort_batch
+from igloo_tpu.exec.sort_limit import limit_batch, sort_batch, topk_batch
 from igloo_tpu.plan import logical as L
 from igloo_tpu.sql.ast import JoinType
 from igloo_tpu.utils import tracing
@@ -381,14 +381,24 @@ class FusedCompiler:
                 rmeta.capacity, lmeta.capacity,
                 banned=bool(self.ex._cache.get(("nopallas_probe",
                                                 pfp_core))))
+        # Pallas match-materialization dispatch rides the same conventions:
+        # plan in the fingerprint, window overflow on the flag channel,
+        # staged-format ban key shared across tiers
+        mplan = dispatch.plan_match(
+            lmeta.capacity, spec_cap,
+            banned=bool(self.ex._cache.get(("nopallas_match", pfp_core))))
         self._push(("join_sorted",) + jfp[1:] + (spec_cap, plan.schema,
-                                                 pplan),
+                                                 pplan, mplan),
                    hint_fp=("join_sorted",) + jfp_core[1:] + (plan.schema,))
         fid = self._new_flag(("overflow", jfp))
         pfid = None
         if pplan is not None:
             pfid = self._new_flag(("pallas_probe", pfp_core))
             self.pallas_bans.append(("nopallas_probe", pfp_core))
+        mfid = None
+        if mplan is not None and mplan[1] == "kernel":
+            mfid = self._new_flag(("pallas_match", pfp_core))
+            self.pallas_bans.append(("nopallas_match", pfp_core))
 
         def fn(leaves, consts, ctx):
             lb = lfn(leaves, consts, ctx)
@@ -398,8 +408,13 @@ class FusedCompiler:
             ctx.flags[fid] = p.total > spec_cap
             if pfid is not None:
                 ctx.flags[pfid] = p.ovf
-            return expand_phase(lb, rb, p, spec_cap, jt, residual,
-                                plan.schema, consts)
+            out = expand_phase(lb, rb, p, spec_cap, jt, residual,
+                               plan.schema, consts, match_plan=mplan)
+            if mplan is not None:
+                out, movf = out
+                if mfid is not None:
+                    ctx.flags[mfid] = movf
+            return out
         return fn, NodeMeta(plan.schema, out_dicts, out_bounds, out_cap)
 
     def _c_join_direct(self, plan, jfp, jfp_core, pick, lfn, lmeta, rfn,
@@ -627,6 +642,8 @@ class FusedCompiler:
         return fn, meta
 
     def _c_limit(self, plan: L.Limit):
+        if isinstance(plan.input, L.Sort) and plan.limit is not None:
+            return self._c_limit_sort(plan, plan.input)
         cfn, meta = self._c(plan.input)
         self._push(("limit", plan.limit, plan.offset))
 
@@ -634,3 +651,50 @@ class FusedCompiler:
             return limit_batch(cfn(leaves, consts, ctx), plan.limit,
                                plan.offset)
         return fn, meta
+
+    def _c_limit_sort(self, plan: L.Limit, sp: L.Sort):
+        """ORDER BY + LIMIT fusion (docs/kernels.md): dispatch.plan_topk
+        replaces the full argsort with a partial top-k when LIMIT + OFFSET
+        is small against the batch and the prefix packing covers every key.
+        The decline path pushes fingerprints BYTE-IDENTICAL to the unfused
+        sort + limit pair, so program keys and hint keys never move when the
+        plan says no."""
+        cfn, meta = self._c(sp.input)
+        comp = self._compiler_for(meta)
+        res, keys = self._compile_exprs(sp.keys, comp)
+        keys = [rank_lane(k, comp) if k.dtype.is_string else k for k in keys]
+        self.marks.extend(comp.marks)
+        pack = K.plan_prefix_packing(keys, sp.ascending, sp.nulls_first,
+                                     self.pool)
+        if pack is not None:
+            tracing.counter("pack.sort")
+        asc, nf = list(sp.ascending), list(sp.nulls_first)
+        k_total = plan.limit + plan.offset
+        # ban key mirrors the staged executor's topk core (cross-tier rule)
+        tfp_core = ("|".join(repr(e) for e in res), tuple(sp.ascending),
+                    tuple(sp.nulls_first))
+        tplan = dispatch.plan_topk(
+            meta.capacity, k_total,
+            pack is not None and pack[1] == len(keys),
+            banned=bool(self.ex._cache.get(("nopallas_topk", tfp_core))))
+        if tplan is None:
+            self._push(("sort", tuple(repr(e) for e in res),
+                        tuple(sp.ascending), tuple(sp.nulls_first), pack))
+            self._push(("limit", plan.limit, plan.offset))
+
+            def fn(leaves, consts, ctx):
+                b = sort_batch(cfn(leaves, consts, ctx), keys, asc, nf,
+                               consts, pack=pack)
+                return limit_batch(b, plan.limit, plan.offset)
+            return fn, meta
+        out_cap = round_capacity(k_total)
+        self._push(("topk", tuple(repr(e) for e in res),
+                    tuple(sp.ascending), tuple(sp.nulls_first), pack, tplan,
+                    plan.limit, plan.offset, out_cap))
+        if tplan[1] == "pallas":
+            self.pallas_bans.append(("nopallas_topk", tfp_core))
+
+        def fn(leaves, consts, ctx):
+            return topk_batch(cfn(leaves, consts, ctx), keys, consts, pack,
+                              tplan, plan.limit, plan.offset, out_cap)
+        return fn, NodeMeta(meta.schema, meta.dicts, meta.bounds, out_cap)

@@ -337,23 +337,49 @@ def semi_anti_phase(left: DeviceBatch, right: DeviceBatch,
 def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
                  match_cap: int, join_type: JoinType,
                  residual: Optional[Compiled],
-                 out_schema: T.Schema, consts: tuple = ()) -> DeviceBatch:
-    """Jit-traceable (match_cap static). Builds the output batch."""
+                 out_schema: T.Schema, consts: tuple = (),
+                 match_plan=None):
+    """Jit-traceable (match_cap static). Builds the output batch.
+
+    `match_plan` (dispatch.plan_match, part of the caller's cache key)
+    routes slot-ownership materialization — the owner-scatter +
+    associative-scan chain below — through the Pallas match kernel (route
+    "kernel": one blocked pass with a bounded per-row window, overflow
+    deferred) or a searchsorted inversion (route "search": exact, the
+    algorithmic fast path for the non-Pallas tier). With a plan the return
+    value is ``(batch, match_ovf)`` — the aggregate_batch conditional-tuple
+    convention; route "search" never overflows."""
     cap_l = left.capacity
 
     # --- candidate expansion: slot j -> (probe row, j-th candidate) ---
     j = jnp.arange(match_cap, dtype=jnp.int64)
-    # probe row owning each slot: scatter each row's index at its start slot,
-    # then a running max fills its run. (a searchsorted over the 8M-lane
-    # prefix costs ~1.5s on TPU — a 23-pass gather loop — vs ~0.3s for
-    # scatter+cummax; zero-count rows share their successor's start slot and
-    # lose the scatter-max tie to the true owner, which has the larger index)
-    starts = jnp.clip(p.prefix, 0, match_cap - 1).astype(jnp.int32)
-    row_ids = jnp.arange(cap_l, dtype=jnp.int32)
-    owner = jnp.zeros((match_cap,), dtype=jnp.int32).at[starts].max(
-        jnp.where(p.counts > 0, row_ids, 0), mode="drop")
-    probe_idx = jax.lax.associative_scan(jnp.maximum, owner)
-    probe_idx = jnp.clip(probe_idx, 0, cap_l - 1)
+    match_ovf = None
+    if match_plan is not None and match_plan[1] == "kernel":
+        owner, match_ovf = dispatch.match_table(match_plan, p.prefix,
+                                                p.counts, match_cap)
+        probe_idx = jnp.clip(owner, 0, cap_l - 1)
+    elif match_plan is not None:
+        # route "search": the prefix lane is sorted (cumsum), so the owner of
+        # slot j is the LAST row whose start is <= j — zero-count rows share
+        # their successor's start and lose the right-insertion tie to the
+        # true owner; stragglers die on the offset bound below
+        match_ovf = jnp.zeros((), jnp.bool_)
+        probe_idx = jnp.clip(
+            jnp.searchsorted(p.prefix, j, side="right").astype(jnp.int32) - 1,
+            0, cap_l - 1)
+    else:
+        # probe row owning each slot: scatter each row's index at its start
+        # slot, then a running max fills its run. (a searchsorted over the
+        # 8M-lane prefix costs ~1.5s on TPU — a 23-pass gather loop — vs
+        # ~0.3s for scatter+cummax; zero-count rows share their successor's
+        # start slot and lose the scatter-max tie to the true owner, which
+        # has the larger index)
+        starts = jnp.clip(p.prefix, 0, match_cap - 1).astype(jnp.int32)
+        row_ids = jnp.arange(cap_l, dtype=jnp.int32)
+        owner = jnp.zeros((match_cap,), dtype=jnp.int32).at[starts].max(
+            jnp.where(p.counts > 0, row_ids, 0), mode="drop")
+        probe_idx = jax.lax.associative_scan(jnp.maximum, owner)
+        probe_idx = jnp.clip(probe_idx, 0, cap_l - 1)
     in_range = j < p.total
     offset = (j - jnp.take(p.prefix, probe_idx)).astype(jnp.int32)
     # rows with count 0 can be hit when prefix repeats; reject by offset bound
@@ -411,12 +437,17 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
         r_matched = jnp.zeros((right.capacity,), dtype=jnp.int32) \
             .at[r_idx].max(ok32, mode="drop") > 0
 
+    def _ret(b):
+        return b if match_plan is None else (b, match_ovf)
+
     if join_type is JoinType.SEMI:
-        return DeviceBatch(out_schema, left.columns, left.live & l_matched)
+        return _ret(DeviceBatch(out_schema, left.columns,
+                                left.live & l_matched))
     if join_type is JoinType.ANTI:
         # NOT IN null semantics live in the binder-built residual (binder.py
         # _rewrite_in_subquery), not here — plain anti is correct as-is
-        return DeviceBatch(out_schema, left.columns, left.live & ~l_matched)
+        return _ret(DeviceBatch(out_schema, left.columns,
+                                left.live & ~l_matched))
 
     # --- inner part: verified expanded rows, NOT compacted (live rows stay
     # mask-scattered across the match_cap slots; every downstream operator is
@@ -472,7 +503,7 @@ def expand_phase(left: DeviceBatch, right: DeviceBatch, p: _Probe,
                             if c.nulls is not None else None)
                     for c in out_cols]
         out_live = jnp.take(out_live, perm)
-    return DeviceBatch(out_schema, out_cols, out_live)
+    return _ret(DeviceBatch(out_schema, out_cols, out_live))
 
 
 def _null_cols(batch: DeviceBatch, cap: int) -> list[DeviceColumn]:

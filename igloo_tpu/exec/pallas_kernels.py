@@ -39,9 +39,13 @@ cache converges. ``interpret=True`` runs the kernels through the Pallas
 interpreter on CPU — that is how tier-1 asserts equivalence without
 hardware (``IGLOO_TPU_PALLAS=interpret``).
 
-Access policy: ``exec/dispatch.py`` is the ONLY legal caller (igloo-lint
-``pallas-dispatch`` rule) — the flag and the fallback ladder must not be
-bypassable.
+Block shapes and tables can also come from the per-shape tuning table
+(``exec/autotune.py``, docs/kernels.md#autotuner) — tuned values still pass
+through the same planner eligibility clamps.
+
+Access policy: ``exec/dispatch.py`` and ``exec/autotune.py`` (the candidate
+benchmark harness) are the ONLY legal callers (igloo-lint ``pallas-dispatch``
+rule) — the flag and the fallback ladder must not be bypassable.
 """
 from __future__ import annotations
 
@@ -374,3 +378,176 @@ def fused_gather(cols: list, idx: jax.Array, block: int,
         interpret=interpret,
     )(idx, *cols)
     return list(outs)
+
+
+# ---------------------------------------------------------------------------
+# 4. ragged match materialization (join expand)
+# ---------------------------------------------------------------------------
+
+def _match_kernel(pre_ref, cnt_ref, own_ref, ovf_ref, *, window: int,
+                  block: int, match_cap: int):
+    """One probe-row block: each row claims its own run of output slots
+    [prefix, prefix+count) in the match-capacity-resident owner table — the
+    runs are disjoint (prefix is the exclusive cumsum of counts), so at most
+    one row writes any slot and scatter-max is exact, not an arbitration.
+    Rows whose run extends past the bounded `window` leave slots unclaimed
+    and raise the overflow flag (the dispatch layer's deferred-flag protocol
+    re-runs the exact expand). Slots no live run covers keep the init value
+    0 — they differ from the sort path's scan-filled owners but are dead by
+    construction (`offset`/`in_range` masking in ``join.expand_phase``)."""
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        own_ref[...] = jnp.zeros_like(own_ref)
+        ovf_ref[...] = jnp.zeros_like(ovf_ref)
+
+    p = pre_ref[...]
+    cnt = cnt_ref[...]
+    pos = (pl.program_id(0) * block +
+           jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0])
+    own = own_ref[...]
+    for off in range(window):
+        tgt = jnp.where((off < cnt) & (p + off < match_cap), p + off,
+                        match_cap)
+        own = own.at[tgt].max(pos, mode="drop")
+    own_ref[...] = own
+    ovf_ref[...] = ovf_ref[...] | jnp.any(cnt > window)
+
+
+def match_owner_table(prefix: jax.Array, counts: jax.Array, match_cap: int,
+                      window: int, block: int, interpret: bool):
+    """(owner, overflow): `owner[j]` is the probe row whose match run covers
+    output slot `j`, for every live slot `j < total` — the values
+    ``join.expand_phase`` derives from its owner-scatter + associative-scan
+    chain, produced in one blocked pass with a bounded per-row emission
+    window (the Ragged-Paged-Attention idiom shared with `_probe_kernel`).
+    `overflow` True means some row's run exceeded the window and the result
+    must be discarded."""
+    cap_l = counts.shape[0]
+    pre = jnp.clip(prefix, 0, match_cap).astype(jnp.int32)
+    kernel = functools.partial(_match_kernel, window=window, block=block,
+                               match_cap=match_cap)
+    own, ovf = pl.pallas_call(
+        kernel,
+        grid=(cap_l // block,),
+        in_specs=[pl.BlockSpec((block,), lambda i: (i,)),
+                  pl.BlockSpec((block,), lambda i: (i,))],
+        out_specs=[pl.BlockSpec((match_cap,), lambda i: (0,)),
+                   pl.BlockSpec((1,), lambda i: (0,))],
+        out_shape=[jax.ShapeDtypeStruct((match_cap,), jnp.int32),
+                   jax.ShapeDtypeStruct((1,), jnp.bool_)],
+        interpret=interpret,
+    )(pre, counts.astype(jnp.int32))
+    return own, ovf[0]
+
+
+# ---------------------------------------------------------------------------
+# 5. blocked partial top-k (sort_limit)
+# ---------------------------------------------------------------------------
+
+def _topk_kernel(key_ref, okey_ref, opos_ref, *, k: int, block: int):
+    """One input block: select the block's k smallest packed keys by k
+    static rounds of (min, first-position-of-min), emitting (key, position)
+    candidates in ascending key order with position-ascending ties — the
+    stable argsort's order. Dead rows carry the displaced MAX sentinel and
+    only surface when a block has fewer than k live rows."""
+    keys = key_ref[...]
+    pos = (pl.program_id(0) * block +
+           jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0])
+    sentinel = jnp.asarray(jnp.iinfo(keys.dtype).max, keys.dtype)
+    ok = jnp.zeros((k,), keys.dtype)
+    op = jnp.zeros((k,), jnp.int32)
+    cur = keys
+    for j in range(k):
+        m = jnp.min(cur)
+        wp = jnp.min(jnp.where(cur == m, pos, _BIG_POS))
+        ok = ok.at[j].set(m)
+        op = op.at[j].set(wp)
+        cur = jnp.where(pos == wp, sentinel, cur)
+    okey_ref[...] = ok
+    opos_ref[...] = op
+
+
+def blocked_topk(sort_key: jax.Array, k: int, block: int, interpret: bool):
+    """(keys, positions) of each block's k smallest entries in the packed
+    sort-key lane — `n // block` candidate groups of k, in block-major
+    order. The global k smallest are a subset of the candidates (every
+    block contributes its own k smallest), and a stable argsort over the
+    flattened candidate keys reproduces the full lane's stable order for
+    the first k: within a block ties are emitted position-ascending, and
+    across blocks the flattened (block-major) order IS position-ascending."""
+    n = sort_key.shape[0]
+    kernel = functools.partial(_topk_kernel, k=k, block=block)
+    keys, pos = pl.pallas_call(
+        kernel,
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec((block,), lambda i: (i,))],
+        out_specs=[pl.BlockSpec((k,), lambda i: (i,)),
+                   pl.BlockSpec((k,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct(((n // block) * k,), sort_key.dtype),
+                   jax.ShapeDtypeStruct(((n // block) * k,), jnp.int32)],
+        interpret=interpret,
+    )(sort_key)
+    return keys, pos
+
+
+# ---------------------------------------------------------------------------
+# 6. exchange hash + partition scatter
+# ---------------------------------------------------------------------------
+
+# hash64 constants — MUST match cluster/exchange.py bit for bit: both sides
+# of an exchange (device-routing sender, numpy-routing receiver) must agree
+# on bucket placement with no coordination
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_MIX = np.uint64(0xC2B2AE3D27D4EB4F)
+_SEED = np.uint64(0x243F6A8885A308D3)
+
+
+def _scatter_kernel(*refs, ncols: int, nbuckets: int):
+    """One row block: finish the per-column hash (golden-ratio multiply +
+    xor-shift over the canonical pre-mix value lanes), fold the columns into
+    the seeded combined key hash, take the bucket id from the high bits, and
+    scatter-add the per-bucket counts into the resident histogram — numpy's
+    `_hash_column` + `key_hash` + `bucket_ids` + `bincount` chain, fused
+    into one pass over the rows."""
+    val_refs = refs[:ncols]
+    live_ref = refs[ncols]
+    pid_ref, cnt_ref = refs[ncols + 1], refs[ncols + 2]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        cnt_ref[...] = jnp.zeros_like(cnt_ref)
+
+    blk = live_ref.shape[0]
+    h = jnp.full((blk,), _SEED, jnp.uint64)
+    for c in range(ncols):
+        v = val_refs[c][...].astype(jnp.uint64) * _GOLDEN
+        v = v ^ (v >> np.uint64(29))
+        h = (h ^ v) * _MIX
+        h = h ^ (h >> np.uint64(33))
+    pid = ((h >> np.uint64(17)) % np.uint64(nbuckets)).astype(jnp.int32)
+    pid_ref[...] = pid
+    lv = live_ref[...]
+    cnt_ref[...] = cnt_ref[...].at[jnp.where(lv, pid, nbuckets)].add(
+        jnp.ones((blk,), jnp.int64), mode="drop")
+
+
+def hash_scatter(val_lanes: list, live: jax.Array, nbuckets: int, block: int,
+                 interpret: bool):
+    """(bucket_ids, counts) over the padded canonical row lanes: per-row
+    exchange bucket ids (int32, identical to ``exchange.bucket_ids``) and
+    the per-bucket live-row histogram (int64, identical to ``np.bincount``
+    over the unpadded rows)."""
+    n = live.shape[0]
+    kernel = functools.partial(_scatter_kernel, ncols=len(val_lanes),
+                               nbuckets=nbuckets)
+    blk_spec = pl.BlockSpec((block,), lambda i: (i,))
+    pid, counts = pl.pallas_call(
+        kernel,
+        grid=(n // block,),
+        in_specs=[blk_spec] * (len(val_lanes) + 1),
+        out_specs=[blk_spec, pl.BlockSpec((nbuckets,), lambda i: (0,))],
+        out_shape=[jax.ShapeDtypeStruct((n,), jnp.int32),
+                   jax.ShapeDtypeStruct((nbuckets,), jnp.int64)],
+        interpret=interpret,
+    )(*val_lanes, live)
+    return pid, counts

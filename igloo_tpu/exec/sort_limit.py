@@ -15,6 +15,7 @@ from typing import Optional
 
 import jax.numpy as jnp
 
+from igloo_tpu.exec import dispatch
 from igloo_tpu.exec import kernels as K
 from igloo_tpu.exec.batch import DeviceBatch
 from igloo_tpu.exec.expr_compile import Compiled, Env
@@ -48,6 +49,36 @@ def sort_batch(batch: DeviceBatch, keys: list[Compiled], ascending: list[bool],
         lanes.extend(K.sort_lanes_for(v, nl, k.dtype.is_float, asc, nf))
     perm = K.lex_argsort(lanes, batch.live)
     return K.apply_perm(batch, perm)
+
+
+def topk_batch(batch: DeviceBatch, keys: list[Compiled],
+               consts: tuple, pack: tuple, plan: tuple,
+               limit: int, offset: int, out_cap: int) -> DeviceBatch:
+    """Jit-traceable fused ORDER BY + LIMIT: a partial top-k over the fully
+    packed sort lane replaces the full argsort when LIMIT ≪ rows. `plan`
+    (dispatch.plan_topk, part of the caller's cache key) requires `pack` to
+    cover EVERY key, so one packed lane totally orders the rows — the
+    selected positions are the stable sort's first LIMIT+OFFSET, and the
+    output batch shrinks to `out_cap` (the LIMIT's capacity family member)
+    instead of carrying the input capacity with a mask. Rows are
+    bit-identical to ``sort_batch`` + ``limit_batch``."""
+    env = Env.from_batch(batch, consts)
+    vals, nls = [], []
+    for k in keys:
+        v, nl = k.fn(env)
+        vals.append(v)
+        nls.append(nl)
+    spec, _ = pack
+    packed = K.pack_key_lane(spec, vals, nls, consts)
+    perm = dispatch.topk_perm(plan, K.packed_sort_key(packed, batch.live))
+    k_total = limit + offset
+    if out_cap > k_total:
+        perm = jnp.concatenate(
+            [perm, jnp.zeros((out_cap - k_total,), perm.dtype)])
+    cols = K.gather_batch(batch, perm)
+    io = jnp.arange(out_cap)
+    live = jnp.take(batch.live, perm) & (io >= offset) & (io < k_total)
+    return DeviceBatch(batch.schema, cols, live)
 
 
 def limit_batch(batch: DeviceBatch, limit, offset: int = 0) -> DeviceBatch:

@@ -88,6 +88,15 @@ CHOKE_POINTS = {
         "first sight of a subtree costs one live-count sync to seed the "
         "persistent hint (same contract as Executor._adaptive_input); "
         "later runs are sync-free.",
+    ("igloo_tpu/exec/autotune.py", "_bench_candidate.timed"):
+        "the autotuner's candidate benchmark harness: block_until_ready IS "
+        "the measurement (sweep mode / offline script only, never on a "
+        "query's hot path).",
+    ("igloo_tpu/exec/dispatch.py", "exchange_scatter"):
+        "the exchange partition is a HOST operation (Arrow table in, bucket "
+        "slices out): the kernel's bucket lane must come back to drive "
+        "table.take — one readback replacing the numpy hash+argsort it "
+        "displaced.",
 }
 
 _SOURCE_PREFIXES = ("jnp.", "jax.lax.", "jax.nn.", "jax.numpy.")

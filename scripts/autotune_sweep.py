@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Offline kernel autotune sweep (docs/kernels.md#autotuner).
+
+Benchmarks the `exec/autotune.py` candidate grid per (kernel, canonical
+capacity) pair on THIS machine's tier (Pallas interpret off TPU, compiled on
+hardware) and persists the winners to the tuning table — the JSON beside the
+XLA compile cache, or the path in IGLOO_AUTOTUNE_TABLE. Every later process
+that shares the table (or pulls it over the cluster compile-cache transfer)
+starts warm: `dispatch` planners read the winning shapes, and the table
+version folds into the jit cache token so tuned programs never collide with
+untuned ones.
+
+Run it once per hardware generation, off the serving path:
+
+    IGLOO_TPU_PALLAS=1 python scripts/autotune_sweep.py            # on TPU
+    python scripts/autotune_sweep.py --kernels match,topk --caps 65536,262144
+
+Prints the winner map as JSON on stdout (stderr carries progress).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("IGLOO_TPU_PALLAS", "interpret")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--kernels", default=None,
+                    help="comma list (default: every swept kernel)")
+    ap.add_argument("--caps", default=None,
+                    help="comma list of capacities (rounded to canonical; "
+                         "default: capacity.tuning_capacities())")
+    args = ap.parse_args(argv)
+
+    from igloo_tpu.exec import autotune
+
+    kernels = args.kernels.split(",") if args.kernels else None
+    caps = [int(c) for c in args.caps.split(",")] if args.caps else None
+    t0 = time.perf_counter()
+    winners = autotune.sweep_offline(kernels=kernels, caps=caps)
+    tab = autotune.table()
+    print(f"autotune-sweep: {len(winners)} winners in "
+          f"{time.perf_counter() - t0:.1f}s -> "
+          f"{tab._path or '(in-memory only; set IGLOO_AUTOTUNE_TABLE)'} "
+          f"version {tab.version()}", file=sys.stderr)
+    json.dump({"table_version": tab.version(), "winners": winners},
+              sys.stdout, indent=2, sort_keys=True)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,12 @@ WATCH_COUNTERS = (
     "fused.compact_repair",
     "pallas.probe_overflow",
     "pallas.agg_overflow",
+    # PR19 kernel fleet: a growing match-window overflow or fallback count
+    # means a kernel stopped adopting (or started repairing) — a route flip
+    # that explains wall-time drift before it trips the time gate
+    "pallas.match_overflow",
+    "pallas.fallback.banned",
+    "pallas.compile_fallback",
     "exchange.spills",
     # distributed out-of-core (docs/out_of_core.md): spill volume growing
     # means the streaming exchange holds less resident per bucket, remote

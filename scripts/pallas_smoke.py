@@ -1,14 +1,24 @@
 #!/usr/bin/env python
 """Pallas interpret-mode equivalence sweep (validate.sh gate; seconds, CPU).
 
-Randomized ragged inputs through all three kernels vs the sort path:
+Randomized ragged inputs through the kernel fleet vs the sort path:
 
 - kernel-level probe bounds vs join._probe_bounds across duplicate-run
   densities, displaced-NULL and dead-row sentinel runs, an EMPTY build
   side, and all-one-key skew (must raise the overflow flag, never emit);
-- engine-level join + multi-agg GROUP BY under IGLOO_TPU_PALLAS=interpret
-  vs =0 (null lanes included) — results must match row-for-row;
-- fused gather vs per-lane jnp.take across dtypes.
+- match-materialization owner tables vs the prefix/counts contract across
+  zero-count densities, with long-run inputs REQUIRED to flag overflow;
+- blocked top-k vs the full stable argsort's first k, ties included
+  (stable rule: lowest position first);
+- exchange hash + partition scatter vs the numpy mix
+  (cluster/exchange.bucket_ids) over string/float/int/date lanes with
+  nulls — bit-identical bucket ids, order, and counts;
+- engine-level join + multi-agg GROUP BY + ORDER BY LIMIT under
+  IGLOO_TPU_PALLAS=interpret vs =0 (null lanes included) — results must
+  match row-for-row;
+- fused gather vs per-lane jnp.take across dtypes;
+- tuning-table persist/reload round-trip (exec/autotune.py): recorded
+  winners survive a process-singleton reset and flip dispatch.cache_token.
 """
 import os
 import sys
@@ -74,6 +84,104 @@ def probe_sweep():
     log("probe kernel equivalence OK (6 seeds + skew flag)")
 
 
+def match_sweep():
+    os.environ["IGLOO_TPU_PALLAS"] = "interpret"
+    for seed in range(6):
+        rng = np.random.default_rng(100 + seed)
+        cap_l = int(rng.choice([64, 256, 1024]))
+        counts = rng.integers(0, 4, cap_l)
+        counts[rng.random(cap_l) < 0.4] = 0
+        prefix = np.cumsum(counts) - counts
+        match_cap = max(int(counts.sum()), 8)
+        plan = dispatch.plan_match(cap_l, match_cap)
+        assert plan is not None and plan[1] == "kernel", plan
+        own, ovf = dispatch.match_table(plan, jnp.asarray(prefix),
+                                        jnp.asarray(counts.astype(np.int32)),
+                                        match_cap)
+        assert not bool(ovf), f"seed {seed}: spurious overflow"
+        own = np.asarray(own)
+        for p in range(cap_l):
+            for off in range(int(counts[p])):
+                j = int(prefix[p]) + off
+                if j < match_cap:
+                    assert own[j] == p, f"seed {seed}: slot {j}"
+    # a run longer than the window MUST flag
+    counts = np.zeros(64, np.int32)
+    counts[10] = dispatch.MATCH_WINDOW + 3
+    prefix = (np.cumsum(counts) - counts).astype(np.int64)
+    plan = dispatch.plan_match(64, 64)
+    _own, ovf = dispatch.match_table(plan, jnp.asarray(prefix),
+                                     jnp.asarray(counts), 64)
+    assert bool(ovf), "long match run must overflow the window"
+    log("match kernel equivalence OK (6 seeds + overflow flag)")
+
+
+def topk_sweep():
+    os.environ["IGLOO_TPU_PALLAS"] = "interpret"
+    for seed in range(6):
+        rng = np.random.default_rng(200 + seed)
+        n = int(rng.choice([256, 1024, 4096]))
+        k = int(rng.choice([1, 7, 64]))
+        # heavy ties: the stable rule (lowest position first) must hold
+        keys = rng.integers(0, max(n // 8, 2), n).astype(np.int64)
+        ref = np.argsort(keys, kind="stable")[:k]
+        for plan in (("topk", "alg", k),
+                     dispatch.plan_topk(n, k, True)):
+            assert plan is not None, (seed, k, n)
+            perm = np.asarray(dispatch.topk_perm(plan, jnp.asarray(keys)))
+            assert (perm == ref).all(), f"seed {seed} plan {plan[1]}"
+    log("top-k equivalence OK (6 seeds, ties, alg + pallas routes)")
+
+
+def scatter_sweep():
+    os.environ["IGLOO_TPU_PALLAS"] = "interpret"
+    from igloo_tpu.cluster import exchange
+    rng = np.random.default_rng(7)
+    n = 3000
+    tbl = pa.table({
+        "s": pa.array([None if i % 97 == 0 else f"k{i % 211}"
+                       for i in range(n)]),
+        "f": pa.array([None if i % 89 == 0 else float(v)
+                       for i, v in enumerate(rng.normal(size=n))]),
+        "i": pa.array([None if i % 83 == 0 else int(v) for i, v in
+                       enumerate(rng.integers(-10**9, 10**9, n))],
+                      type=pa.int64()),
+    })
+    for nb in (4, 7, 32):
+        ref = exchange.bucket_ids(tbl, [0, 1, 2], nb)
+        pid, order, counts = exchange._partition_arrays(tbl, [0, 1, 2], nb)
+        assert order is not None, "scatter kernel did not adopt"
+        assert (pid == ref).all(), f"bucket ids differ (nb={nb})"
+        assert (order == np.argsort(ref, kind="stable")).all()
+        assert (counts == np.bincount(ref, minlength=nb)).all()
+    log("exchange scatter equivalence OK (3 bucket counts, 3 key dtypes)")
+
+
+def autotune_roundtrip():
+    import tempfile
+    from igloo_tpu.exec import autotune
+    os.environ["IGLOO_TPU_PALLAS"] = "interpret"
+    with tempfile.TemporaryDirectory() as td:
+        os.environ[autotune.TABLE_PATH_ENV] = os.path.join(td, "t.json")
+        try:
+            autotune.reset_table()
+            token0 = dispatch.cache_token()
+            autotune.table().record("match", 65536, {"window": 8,
+                                                     "block": 512})
+            assert dispatch.cache_token() != token0, \
+                "recording a winner must flip the jit cache token"
+            autotune.reset_table()  # fresh singleton = fresh process
+            rec = autotune.table().lookup("match", 65536)
+            assert rec == {"window": 8, "block": 512}, rec
+            assert autotune.table_version() >= 1
+            plan = dispatch.plan_match(65536, 65536)
+            assert plan is not None and plan[2] == 8 and plan[3] == 512, plan
+        finally:
+            os.environ.pop(autotune.TABLE_PATH_ENV, None)
+            autotune.reset_table()
+    log("tuning table persist/reload round-trip OK (token flip + plan)")
+
+
 def engine_sweep():
     from igloo_tpu.engine import QueryEngine
     import igloo_tpu.engine as eng
@@ -108,6 +216,7 @@ def engine_sweep():
         "SELECT lv, COUNT(*) FROM l LEFT JOIN r ON lk = rk GROUP BY lv",
         "SELECT a, b, SUM(x), COUNT(*), MIN(x), MAX(b), AVG(x) "
         "FROM t GROUP BY a, b",
+        "SELECT a, b FROM t ORDER BY a, b LIMIT 7",
     ]
 
     def run(mode):
@@ -127,6 +236,8 @@ def engine_sweep():
             if k.startswith("pallas.") and v}
     assert d.get("pallas.probe") > 0, used
     assert d.get("pallas.segagg") > 0, used
+    assert d.get("pallas.match") > 0, used
+    assert d.get("pallas.topk") > 0, used
     log(f"engine equivalence OK ({len(queries)} queries; counters {used})")
 
 
@@ -148,7 +259,11 @@ def gather_sweep():
 def main():
     t0 = time.perf_counter()
     probe_sweep()
+    match_sweep()
+    topk_sweep()
+    scatter_sweep()
     gather_sweep()
+    autotune_roundtrip()
     engine_sweep()
     log(f"OK in {time.perf_counter() - t0:.1f}s")
     return 0

@@ -1,0 +1,8 @@
+"""Mirror of the real exec/autotune.py exemption: the autotuner benchmarks
+candidate shapes by invoking the kernels directly on synthetic lanes, so it
+joins exec/dispatch.py in the pallas-dispatch allowlist."""
+from igloo_tpu.exec import pallas_kernels
+
+
+def bench_scatter(lanes, live, nbuckets, block, interp):
+    return pallas_kernels.hash_scatter(lanes, live, nbuckets, block, interp)

@@ -1,0 +1,333 @@
+"""Per-shape kernel autotuner (exec/autotune.py) + the v2 kernel routes:
+tuning-table persist/reload and merge semantics, cache_token coupling,
+planner adoption of tuned shapes, the match/top-k engine-level equivalence
+and fallback ladders, and the LIMIT >= rows direct-path regression.
+Interpreter on tiny canonical shapes — seconds, no hardware."""
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from igloo_tpu.exec import autotune, dispatch
+from igloo_tpu.utils import tracing
+
+
+@pytest.fixture
+def tuned_path(tmp_path, monkeypatch):
+    """Point the table singleton at a fresh temp file for the test, and put
+    it back (dropping the singleton) afterwards."""
+    p = tmp_path / "autotune.json"
+    monkeypatch.setenv(autotune.TABLE_PATH_ENV, str(p))
+    autotune.reset_table()
+    yield p
+    autotune.reset_table()
+
+
+def _interpret(monkeypatch):
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "interpret")
+
+
+def _engine(*tables):
+    from igloo_tpu.engine import QueryEngine
+    e = QueryEngine()
+    for name, t in tables:
+        e.register_table(name, t)
+    return e
+
+
+# --- the tuning table -------------------------------------------------------
+
+def test_table_persist_reload_roundtrip(tuned_path):
+    t = autotune.table()
+    assert t.version() == 0 and t.lookup("match", 65536) is None
+    t.record("match", 65536, {"window": 8, "block": 512})
+    t.record("topk", 4096, {"block": 2048})
+    assert t.version() == 2
+    autotune.reset_table()                      # fresh singleton = process 2
+    t2 = autotune.table()
+    assert t2 is not t
+    assert t2.version() == 2
+    assert t2.lookup("match", 65536) == {"window": 8, "block": 512}
+    assert t2.lookup("topk", 4096) == {"block": 2048}
+
+
+def test_record_same_params_does_not_bump_version(tuned_path):
+    t = autotune.table()
+    t.record("topk", 4096, {"block": 1024})
+    v = t.version()
+    t.record("topk", 4096, {"block": 1024})
+    assert t.version() == v
+
+
+def test_cache_token_folds_table_version(tuned_path, monkeypatch):
+    _interpret(monkeypatch)
+    tok0 = dispatch.cache_token()
+    autotune.table().record("scatter", 8192, {"block": 256})
+    tok1 = dispatch.cache_token()
+    assert tok1 != tok0
+    # editing the persisted file (cluster adoption lands this way) flips too
+    raw = json.loads(tuned_path.read_text())
+    raw["version"] += 1
+    tuned_path.write_text(json.dumps(raw))
+    autotune.reset_table()
+    assert dispatch.cache_token() not in (tok0, tok1)
+
+
+def test_mode_zero_ignores_table(tuned_path, monkeypatch):
+    autotune.table().record("match", 65536, {"window": 32, "block": 1024})
+    monkeypatch.setenv(autotune.AUTOTUNE_ENV, "0")
+    assert autotune.table_version() == 0
+    assert autotune.shapes("match", 65536) == {}
+    _interpret(monkeypatch)
+    plan = dispatch.plan_match(65536, 65536)
+    assert plan[2] == dispatch.MATCH_WINDOW   # module default, not the table
+
+
+def test_shapes_hit_miss_counters(tuned_path, monkeypatch):
+    monkeypatch.setenv(autotune.AUTOTUNE_ENV, "auto")
+    autotune.table().record("probe", 4096, {"window": 32})
+    with tracing.counter_delta() as d:
+        assert autotune.shapes("probe", 4096) == {"window": 32}
+        assert autotune.shapes("probe", 8192) == {}
+    assert d.get("autotune.hit") == 1
+    assert d.get("autotune.miss") == 1
+    assert d.get("autotune.sweep") == 0       # auto never benchmarks inline
+
+
+def test_merge_raw_higher_version_wins(tuned_path):
+    t = autotune.table()
+    t.record("match", 65536, {"window": 16, "block": 512})     # version 1
+    remote = {"version": 5, "entries": {
+        "match/65536": {"window": 8, "block": 1024},           # conflict
+        "topk/4096": {"block": 2048},                          # new entry
+    }}
+    assert t.merge_raw(remote) is True
+    assert t.lookup("match", 65536) == {"window": 8, "block": 1024}
+    assert t.lookup("topk", 4096) == {"block": 2048}
+    assert t.version() == 6                    # max(1, 5) + 1: converges past both
+    # merging the same remote again changes nothing
+    assert t.merge_raw(remote) is False
+
+
+def test_merge_raw_lower_version_keeps_local_conflicts(tuned_path):
+    t = autotune.table()
+    for _ in range(3):                         # local version 3
+        t.record("match", 65536, {"window": 16, "block": 512})
+        t.record("match", 65536, {"window": 8, "block": 512})
+    v = t.version()
+    stale = {"version": 1, "entries": {"match/65536": {"window": 32,
+                                                       "block": 256},
+                                       "scatter/8192": {"block": 4096}}}
+    assert t.merge_raw(stale) is True          # the NEW entry still lands
+    assert t.lookup("match", 65536) == {"window": 8, "block": 512}
+    assert t.lookup("scatter", 8192) == {"block": 4096}
+    assert t.version() == v + 1
+
+
+def test_compile_cache_merge_hook(tuned_path):
+    t = autotune.table()
+    t.record("topk", 4096, {"block": 512})
+    incoming = json.dumps({"version": 9, "entries": {
+        "topk/4096": {"block": 2048}}}).encode()
+    merged = autotune._merge_entry(None, incoming)
+    out = json.loads(merged.decode())
+    assert out["entries"]["topk/4096"] == {"block": 2048}
+    assert out["version"] >= 9
+    autotune._on_adopted()
+    assert autotune.table().lookup("topk", 4096) == {"block": 2048}
+    # garbage on the wire never corrupts the table
+    assert autotune._merge_entry(b"keep", b"{not json") == b"keep"
+
+
+def test_planner_adopts_tuned_shapes_with_clamps(tuned_path, monkeypatch):
+    _interpret(monkeypatch)
+    autotune.table().record("match", 65536, {"window": 8, "block": 512})
+    plan = dispatch.plan_match(65536, 65536)
+    assert plan[1] == "kernel" and plan[2] == 8 and plan[3] == 512
+    # a corrupt/oversized tuned block still passes through pow2_block: the
+    # planner clamps it to the operand's family, never crashes
+    autotune.table().record("match", 1024, {"window": 8, "block": 10**6})
+    plan2 = dispatch.plan_match(1024, 1024)
+    assert plan2[3] <= 1024 and plan2[3] & (plan2[3] - 1) == 0
+
+
+def test_sweep_persists_winner(tuned_path, monkeypatch):
+    _interpret(monkeypatch)
+    monkeypatch.setenv(autotune.AUTOTUNE_ENV, "sweep")
+    with tracing.counter_delta() as d:
+        won = autotune.shapes("topk", 1024)    # miss -> inline benchmark
+    assert d.get("autotune.sweep") == 1
+    assert won in autotune.CANDIDATES["topk"]
+    assert autotune.table().lookup("topk", 1024) == won
+    with tracing.counter_delta() as d2:
+        assert autotune.shapes("topk", 1024) == won
+    assert d2.get("autotune.sweep") == 0 and d2.get("autotune.hit") == 1
+
+
+def test_cluster_replication_merges_two_workers(tuned_path, monkeypatch,
+                                                tmp_path):
+    """Two workers push divergent tuning tables through the coordinator's
+    compile_cache_put: the registered merge hook folds both (higher-version
+    side wins conflicts, disjoint entries union), and a later
+    compile_cache_get serves the CONVERGED table — the path a second
+    worker's pull cycle takes."""
+    from igloo_tpu import compile_cache as cc
+    from igloo_tpu.cluster.coordinator import CoordinatorServer
+    from igloo_tpu.cluster.rpc import flight_action, flight_action_raw
+    monkeypatch.setattr(cc, "active_dir", lambda: str(tmp_path))
+    coord = CoordinatorServer("grpc+tcp://127.0.0.1:0")
+    try:
+        addr = f"127.0.0.1:{coord.port}"
+        worker_a = {"version": 1, "entries": {
+            "match/65536": {"window": 16, "block": 512},
+            "probe/4096": {"window": 32, "block": 1024, "bucket_shift": 2}}}
+        worker_b = {"version": 4, "entries": {
+            "match/65536": {"window": 8, "block": 1024},   # conflict: b wins
+            "topk/4096": {"block": 2048}}}
+        for t in (worker_a, worker_b):
+            resp = flight_action(addr, "compile_cache_put", {
+                "name": autotune.TABLE_ENTRY,
+                "data": cc.encode_entry(json.dumps(t).encode())})
+            assert resp["stored"] is True
+        served = json.loads(flight_action_raw(
+            addr, "compile_cache_get", {"name": autotune.TABLE_ENTRY}))
+        assert served["entries"]["match/65536"] == {"window": 8,
+                                                    "block": 1024}
+        assert served["entries"]["probe/4096"]["bucket_shift"] == 2
+        assert served["entries"]["topk/4096"] == {"block": 2048}
+        assert served["version"] >= 4
+        assert autotune.TABLE_ENTRY in cc.merge_names()  # workers re-pull it
+    finally:
+        coord.shutdown()
+
+
+# --- engine-level: match + top-k routes -------------------------------------
+
+def _join_tables(seed=7, n=600, nname=400):
+    rng = np.random.default_rng(seed)
+    names = [f"n{i:04d}" for i in range(nname)]
+    left = pa.table({
+        "lk": pa.array(rng.choice(names, 300).tolist()),
+        "lv": pa.array(rng.integers(0, 50, 300), type=pa.int64()),
+    })
+    right = pa.table({
+        "rk": pa.array(rng.choice(names + [None], n).tolist()),
+        "rv": pa.array(rng.integers(0, 99, n), type=pa.int64()),
+    })
+    return ("l", left), ("r", right)
+
+
+_JOIN_SQL = "SELECT lv, rv FROM l JOIN r ON lk = rk"
+
+
+def _rows(t: pa.Table):
+    cols = [[v for v in c] for c in t.to_pydict().values()]
+    return sorted(zip(*cols), key=lambda r: tuple((x is None, x) for x in r))
+
+
+def test_match_kernel_adopted_and_equivalent(monkeypatch):
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    base = _engine(*_join_tables()).execute(_JOIN_SQL)
+    _interpret(monkeypatch)
+    with tracing.counter_delta() as d:
+        got = _engine(*_join_tables()).execute(_JOIN_SQL)
+    assert d.get("pallas.match") > 0
+    assert d.get("pallas.match_overflow") == 0
+    assert _rows(got) == _rows(base)
+
+
+def test_match_overflow_falls_back_exactly(monkeypatch):
+    """A live probe row with more matches than the window: the deferred flag
+    discards the kernel result, the exact path re-runs, and the join's match
+    route is negative-cached (second execution routes 'search', no retry).
+    The window is pinned below the probe window so only the MATCH kernel
+    overflows — the probe kernel's bounds stay exact."""
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    tabs = _join_tables(seed=3, n=600, nname=60)   # ~10 matches per name
+    base = _engine(*tabs).execute(_JOIN_SQL)
+    _interpret(monkeypatch)
+    monkeypatch.setattr(dispatch, "MATCH_WINDOW", 4)
+    e = _engine(*tabs)
+    with tracing.counter_delta() as d:
+        got = e.execute(_JOIN_SQL)
+    assert d.get("pallas.match_overflow") >= 1
+    assert d.get("pallas.probe_overflow") == 0
+    assert _rows(got) == _rows(base)
+    e.result_cache.clear()
+    with tracing.counter_delta() as d2:
+        again = e.execute(_JOIN_SQL)
+    assert d2.get("pallas.match_overflow") == 0    # banned, not retried
+    assert d2.get("pallas.fallback.banned") >= 1
+    assert _rows(again) == _rows(base)
+
+
+def _sort_table(seed=5, n=900):
+    rng = np.random.default_rng(seed)
+    return ("t", pa.table({
+        "a": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+        "b": pa.array([None if v < 30 else int(v)
+                       for v in rng.integers(0, 300, n)], type=pa.int64()),
+        "x": pa.array(rng.normal(size=n)),
+    }))
+
+
+_TOPK_SQL = "SELECT a, b, x FROM t ORDER BY a, b LIMIT 13"
+_FULL_SQL = "SELECT a, b, x FROM t ORDER BY a, b"
+
+
+def _first_k(t: pa.Table, k: int):
+    return [tuple(c[i] for c in t.to_pydict().values()) for i in range(k)]
+
+
+def test_topk_pallas_adopted_and_equivalent(monkeypatch):
+    """ORDER BY + LIMIT over packable keys: the blocked top-k kernel adopts
+    under interpret and reproduces the full stable sort's first k rows —
+    heavy duplicate keys (ties) included."""
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    full = _engine(_sort_table()).execute(_FULL_SQL)
+    _interpret(monkeypatch)
+    with tracing.counter_delta() as d:
+        got = _engine(_sort_table()).execute(_TOPK_SQL)
+    assert d.get("pallas.topk") > 0
+    assert got.num_rows == 13
+    assert _first_k(got, 13) == _first_k(full, 13)
+
+
+def test_topk_alg_route_on_kernels_off_tier(monkeypatch):
+    """The lax.top_k route is mode-independent: with Pallas OFF the partial
+    sort still replaces the full sort (topk.alg counter, no pallas.*) and
+    the rows match the full sort's first k."""
+    monkeypatch.setenv("IGLOO_TPU_PALLAS", "0")
+    full = _engine(_sort_table()).execute(_FULL_SQL)
+    with tracing.counter_delta() as d:
+        got = _engine(_sort_table()).execute(_TOPK_SQL)
+    assert d.get("topk.alg") > 0
+    assert not any(k.startswith("pallas.") and v
+                   for k, v in d.values().items())
+    assert _first_k(got, 13) == _first_k(full, 13)
+
+
+def test_topk_offset_rows(monkeypatch):
+    _interpret(monkeypatch)
+    full = _engine(_sort_table()).execute(_FULL_SQL)
+    got = _engine(_sort_table()).execute(
+        "SELECT a, b, x FROM t ORDER BY a, b LIMIT 10 OFFSET 5")
+    assert got.num_rows == 10
+    assert _first_k(got, 10) == _first_k(full, 15)[5:]
+
+
+def test_limit_ge_rows_takes_direct_path(monkeypatch):
+    """Regression: LIMIT covering most of the batch must NOT route through
+    the partial top-k (2*k > capacity buys nothing) — the planner counts
+    pallas.fallback.large_limit and the full sort path runs."""
+    _interpret(monkeypatch)
+    name, small = _sort_table(n=60)
+    full = _engine((name, small)).execute(_FULL_SQL)
+    with tracing.counter_delta() as d:
+        got = _engine((name, small)).execute(
+            "SELECT a, b, x FROM t ORDER BY a, b LIMIT 100")
+    assert d.get("pallas.fallback.large_limit") >= 1
+    assert d.get("pallas.topk") == 0 and d.get("topk.alg") == 0
+    assert got.num_rows == 60
+    assert _first_k(got, 60) == _first_k(full, 60)

@@ -142,6 +142,13 @@ def test_pallas_dispatch_exempts_the_dispatch_site():
                  [PallasDispatchChecker()]) == []
 
 
+def test_pallas_dispatch_exempts_the_autotuner():
+    # exec/autotune.py benchmarks kernels directly on synthetic lanes — the
+    # second (and last) allowlisted site
+    assert _lint([PKG / "exec" / "autotune.py"],
+                 [PallasDispatchChecker()]) == []
+
+
 # --- metric-names -----------------------------------------------------------
 
 def _metric_checker():

@@ -321,7 +321,7 @@ def test_explain_analyze_records_kernel_choice(monkeypatch):
     e = _engine(*_join_tables(), _agg_table())
     res = e.query("EXPLAIN ANALYZE " + _JOIN_SQL)
     joins = res.stats.find_ops("Join")
-    assert joins and joins[0].attrs.get("pallas") == "probe"
+    assert joins and joins[0].attrs.get("pallas") == "probe+match"
     res2 = e.query("EXPLAIN ANALYZE " + _AGG_SQL)
     aggs = res2.stats.find_ops("Aggregate")
     assert aggs and aggs[0].attrs.get("pallas") == "segagg"
