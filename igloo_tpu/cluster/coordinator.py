@@ -72,6 +72,20 @@ RESULT_CACHE_ENV = "IGLOO_SERVING_RESULT_CACHE"
 #: streaming exists to avoid)
 RESULT_CACHE_MAX_BYTES = 64 << 20
 
+#: lock discipline for the coordinator's shared state (lint: lock-discipline
+#: enforces these module-wide, any receiver). `_lock` covers BOTH instances
+#: of the name: Membership's worker map/evicted set and CoordinatorServer's
+#: table-spec registry — each is touched by the sweeper thread, the Flight
+#: handler pool, and the dispatch pool. `_totals_lock` guards the metrics
+#: publish slot (`last_metrics`) and the cumulative per-worker totals; the
+#: event-journal ingest delegates to cluster/events.py, whose ring carries
+#: its own module-level `_GUARDED_BY`.
+_GUARDED_BY = {
+    "_lock": ("_workers", "_evicted_ids", "_table_specs"),
+    "_queries_lock": ("_queries",),
+    "_totals_lock": ("last_metrics", "worker_totals"),
+}
+
 
 def _is_oom(ex: BaseException) -> bool:
     """An out-of-device-memory failure the degradation ladder can absorb:
@@ -595,7 +609,10 @@ class DistributedExecutor:
             # planner tagged the fragments with (docs/adaptive.md)
             self._record_adaptive(metrics["fragments"])
         pub = {k: v for k, v in metrics.items() if not k.startswith("_")}
-        self.last_metrics = pub  # atomic publish
+        # publish under the totals lock: the Flight `last_metrics` handler
+        # and the demoted/cached publish paths race this slot otherwise
+        with self._totals_lock:
+            self.last_metrics = pub
         self._accumulate(pub)
         stats.log_query(sql, elapsed_s=pub["execution_time_s"],
                         tier="distributed", rows=pub.get("total_rows"),
@@ -1262,10 +1279,11 @@ class CoordinatorServer(flight.FlightServerBase):
         # publish: a demoted query must overwrite last_metrics (clients —
         # and the kill-switch A/B — would otherwise read the PREVIOUS
         # query's oversized/fragment attribution as this one's)
-        self.executor.last_metrics = {
-            "qid": "", "status": "ok", "rows": out.num_rows,
-            "fragments": [], "recoveries": 0, "demoted": 1,
-            "execution_time_s": round(time.time() - t_start, 6)}
+        with self.executor._totals_lock:
+            self.executor.last_metrics = {
+                "qid": "", "status": "ok", "rows": out.num_rows,
+                "fragments": [], "recoveries": 0, "demoted": 1,
+                "execution_time_s": round(time.time() - t_start, 6)}
         return (out.schema, iter(out.to_batches())) if stream else out
 
     def _demote_ladder(self, sql: str, deadline: Optional[float],
@@ -1319,10 +1337,11 @@ class CoordinatorServer(flight.FlightServerBase):
         a tier=result_cache query-log row) and serve the cached table."""
         elapsed = time.time() - t_start
         tid = trace.trace_id if trace is not None else ""
-        self.executor.last_metrics = {
-            "qid": qid or "", "result_cache_hit": True, "status": "ok",
-            "rows": hit.num_rows, "fragments": [], "recoveries": 0,
-            "execution_time_s": round(elapsed, 6), "trace_id": tid}
+        with self.executor._totals_lock:
+            self.executor.last_metrics = {
+                "qid": qid or "", "result_cache_hit": True, "status": "ok",
+                "rows": hit.num_rows, "fragments": [], "recoveries": 0,
+                "execution_time_s": round(elapsed, 6), "trace_id": tid}
         stats.log_query(sql, elapsed_s=elapsed, tier="result_cache",
                         rows=hit.num_rows, started_at=t_start,
                         priority=priority, trace_id=tid)
@@ -1449,7 +1468,9 @@ class CoordinatorServer(flight.FlightServerBase):
                 "tables": sorted(self.engine.catalog.names()),
             }).encode()]
         if action.type == "last_metrics":
-            return [json.dumps(self.executor.last_metrics).encode()]
+            with self.executor._totals_lock:
+                pub = self.executor.last_metrics
+            return [json.dumps(pub).encode()]
         if action.type == "trace":
             # stitched query timeline by trace_id or qid (neither = most
             # recent); Chrome-trace/Perfetto JSON by default, the raw span

@@ -147,6 +147,12 @@ class FaultInjector:
 
 _INJECTOR: Optional[FaultInjector] = None
 _LOADED = False
+# serializes the INSTALLERS only: refresh() can run from any server
+# constructor (Flight handler threads re-registering in tests) and must
+# swap (_INJECTOR, _LOADED) as a unit. Readers (inject()/active()) stay
+# lockless by design — one atomic reference load, stale for at most the
+# call that raced the install.
+_faults_lock = threading.Lock()
 
 
 def refresh() -> Optional[FaultInjector]:
@@ -155,24 +161,29 @@ def refresh() -> Optional[FaultInjector]:
     import call this (or construct a server, which does)."""
     global _INJECTOR, _LOADED
     spec = os.environ.get(FAULTS_ENV, "")
-    _INJECTOR = FaultInjector(spec, int(os.environ.get(SEED_ENV, "0"))) \
+    inj = FaultInjector(spec, int(os.environ.get(SEED_ENV, "0"))) \
         if spec else None
-    _LOADED = True
-    return _INJECTOR
+    with _faults_lock:
+        _INJECTOR = inj
+        _LOADED = True
+    return inj
 
 
 def install(spec: str, seed: int = 0, **kw) -> FaultInjector:
     """Programmatic install (tests); `clear()` to remove."""
     global _INJECTOR, _LOADED
-    _INJECTOR = FaultInjector(spec, seed, **kw)
-    _LOADED = True
-    return _INJECTOR
+    inj = FaultInjector(spec, seed, **kw)
+    with _faults_lock:
+        _INJECTOR = inj
+        _LOADED = True
+    return inj
 
 
 def clear() -> None:
     global _INJECTOR, _LOADED
-    _INJECTOR = None
-    _LOADED = True
+    with _faults_lock:
+        _INJECTOR = None
+        _LOADED = True
 
 
 def active() -> bool:

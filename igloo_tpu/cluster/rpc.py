@@ -29,6 +29,7 @@ import contextlib
 import dataclasses
 import json
 import os
+import threading
 import time
 import weakref
 from dataclasses import dataclass
@@ -210,19 +211,29 @@ def policy_from_env(base: Optional[RpcPolicy] = None) -> RpcPolicy:
 
 
 _default_policy: Optional[RpcPolicy] = None
+# the process-wide policy cache is read by every RPC-issuing thread (worker
+# heartbeat loops, coordinator dispatch pool, Flight handlers forwarding
+# fragments) while config loading may install a policy concurrently — the
+# lazy init below would otherwise race and hand two threads different
+# policies built from a half-read environment
+_policy_lock = threading.Lock()
+
+_GUARDED_BY = {"_policy_lock": ("_default_policy",)}
 
 
 def default_policy() -> RpcPolicy:
     global _default_policy
-    if _default_policy is None:
-        _default_policy = policy_from_env()
-    return _default_policy
+    with _policy_lock:
+        if _default_policy is None:
+            _default_policy = policy_from_env()
+        return _default_policy
 
 
 def set_default_policy(policy: Optional[RpcPolicy]) -> None:
     """Install a process-wide default (config loading); None re-reads env."""
     global _default_policy
-    _default_policy = policy
+    with _policy_lock:
+        _default_policy = policy
 
 
 def retryable(ex: BaseException) -> bool:

@@ -62,9 +62,25 @@ visitors (docs/static_analysis.md has the rule catalog):
                       must have a live reader, and ``[rpc]``/``[serving]``
                       config keys must agree with their documented env twin.
 
-The last three are the framework's first WHOLE-PROGRAM rules: they subclass
-``TwoPassChecker`` (collect per-file summaries, then judge globally), an API
-sync-hazard and jit-key can adopt for cross-module reasoning later.
+- ``thread-roles``    whole-program race detection: every thread-spawn site
+                      (Thread/Timer/pool.submit/weakref.finalize, plus the
+                      Flight handler entry points derived from
+                      cluster/protocol.py's ACTION_SERVERS) is a role; any
+                      ``self.<attr>``/module-global write reachable from
+                      concurrent roles through the conservative call graph
+                      must be locked, ``_GUARDED_BY``-declared, or
+                      allow-commented;
+- ``lock-order``      the nesting order of ``with``-acquired declared locks,
+                      closed over the call graph, must be acyclic (cycles =
+                      potential deadlock; self-loops = re-acquisition of a
+                      non-reentrant Lock).
+
+``wire-contract``/``flight-actions``/``env-knobs`` were the framework's
+first WHOLE-PROGRAM rules on the ``TwoPassChecker`` API (collect per-file
+summaries, then judge globally); ``thread-roles``/``lock-order`` build on
+it, and ``sync-hazard`` adopted it for one level of interprocedural taint
+summaries (a helper returning a device value now taints its callers'
+``int()``/``bool()``/``.item()`` sinks).
 
 Suppress a finding with a trailing ``# lint: allow(<rule>)`` comment on the
 offending line (or a standalone allow-comment on the line directly above);
@@ -226,39 +242,55 @@ def default_checkers() -> list:
     from igloo_tpu.lint.rpc_policy import RpcPolicyChecker
     from igloo_tpu.lint.span_names import SpanNamesChecker
     from igloo_tpu.lint.sync_hazard import SyncHazardChecker
+    from igloo_tpu.lint.thread_roles import (
+        LockOrderChecker, ThreadRolesChecker,
+    )
     from igloo_tpu.lint.wire_contract import WireContractChecker
     return [SyncHazardChecker(), CacheKeyChecker(), JitKeyChecker(),
             LockDisciplineChecker(), MetricNamesChecker(),
             SpanNamesChecker(), EventNamesChecker(), RpcPolicyChecker(),
             PallasDispatchChecker(), WireContractChecker(),
-            FlightActionsChecker(), EnvKnobsChecker()]
+            FlightActionsChecker(), EnvKnobsChecker(),
+            ThreadRolesChecker(), LockOrderChecker()]
 
 
-def _raw_lint(modules: list, checkers: list) -> tuple[list, list]:
-    """Every finding, SUPPRESSIONS INCLUDED, plus warnings."""
+def _raw_lint(modules: list, checkers: list,
+              timings: Optional[dict] = None) -> tuple[list, list]:
+    """Every finding, SUPPRESSIONS INCLUDED, plus warnings. Pass a dict as
+    `timings` to get per-rule wall seconds back (keyed by rule name)."""
+    import time
     findings: list[Finding] = []
     warnings: list[str] = []
     for c in checkers:
+        t0 = time.perf_counter()
         for mod in modules:
             findings.extend(c.check(mod))
         findings.extend(c.finalize(modules))
+        if timings is not None:
+            timings[c.name] = time.perf_counter() - t0
         warnings.extend(getattr(c, "warnings", ()))
     return findings, warnings
 
 
 def run_lint(paths: Optional[list] = None, checkers: Optional[list] = None,
-             select: Optional[set] = None, root: Path = REPO_ROOT
-             ) -> tuple[list, list]:
+             select: Optional[set] = None, root: Path = REPO_ROOT,
+             timings: Optional[dict] = None) -> tuple[list, list]:
     """-> (findings, warnings). `paths` defaults to the igloo_tpu package
-    (lint/ itself excluded); `select` restricts to a subset of rule names."""
+    (lint/ itself excluded); `select` restricts to a subset of rule names;
+    a dict passed as `timings` comes back with per-rule wall seconds plus
+    the shared parse time under the pseudo-rule "(parse)"."""
+    import time
     if checkers is None:
         checkers = default_checkers()
     if select:
         checkers = [c for c in checkers if c.name in select]
     files = paths if paths is not None else iter_package_files()
+    t0 = time.perf_counter()
     modules = [LintModule.parse(Path(p), root=root) for p in files]
+    if timings is not None:
+        timings["(parse)"] = time.perf_counter() - t0
     by_path = {m.relpath: m for m in modules}
-    raw, warnings = _raw_lint(modules, checkers)
+    raw, warnings = _raw_lint(modules, checkers, timings=timings)
     findings = []
     for f in raw:
         m = by_path.get(f.path)
@@ -276,7 +308,14 @@ def stale_allows(paths: Optional[list] = None,
     code moved, or the rule name was always wrong. Returns Findings (rule
     ``stale-allow``) so the CLI renders them like everything else. A stale
     allow is dead weight at best and false cover at worst: the next REAL
-    finding on that line would be silently swallowed."""
+    finding on that line would be silently swallowed.
+
+    Checkers with their own whitelists report staleness the same way: a
+    checker may expose ``stale_entries()`` returning Findings (rule
+    ``stale-entry``) for whitelist rows that no longer match anything —
+    sync-hazard's ``CHOKE_POINTS``/``COLD_MODULES`` rows and
+    lock-discipline's ``_GUARDED_BY`` locks/names — so every suppression
+    surface shrinks monotonically through one report."""
     if checkers is None:
         checkers = default_checkers()
     files = paths if paths is not None else iter_package_files()
@@ -318,5 +357,12 @@ def stale_allows(paths: Optional[list] = None,
                     out.append(Finding(
                         "stale-allow", m.relpath, i,
                         f"allow({rule}) suppresses nothing — remove it"))
+    linted = {m.relpath for m in modules}
+    for c in checkers:
+        hook = getattr(c, "stale_entries", None)
+        if hook is None or c.name in unjudgeable:
+            continue   # partial run: a whole-program whitelist row may only
+            #            LOOK unused because its users weren't linted
+        out.extend(f for f in hook() if f.path in linted)
     out.sort(key=lambda f: (f.path, f.line))
     return out

@@ -24,8 +24,13 @@ def main(argv=None) -> int:
                          "(default: all)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--stale-allows", action="store_true",
-                    help="report `# lint: allow(<rule>)` comments that no "
-                         "longer suppress any finding")
+                    help="report `# lint: allow(<rule>)` comments and "
+                         "checker whitelist rows that no longer suppress "
+                         "any finding")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output: every finding "
+                         "(suppressed ones included, with their allow "
+                         "state) plus per-rule wall seconds")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress warnings and the OK summary")
     args = ap.parse_args(argv)
@@ -62,6 +67,36 @@ def main(argv=None) -> int:
             else:
                 paths.append(p)
 
+    if args.as_json:
+        import json
+
+        from igloo_tpu.lint import LintModule, _raw_lint
+        files = paths if paths is not None else iter_package_files()
+        run = checkers if select is None else \
+            [c for c in checkers if c.name in select]
+        t0 = time.perf_counter()
+        modules = [LintModule.parse(Path(p)) for p in files]
+        parse_s = time.perf_counter() - t0
+        by_path = {m.relpath: m for m in modules}
+        timings: dict = {}
+        raw, warnings = _raw_lint(modules, run, timings=timings)
+        items, live = [], 0
+        for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+            m = by_path.get(f.path)
+            allowed = bool(m is not None and m.allowed(f.rule, f.line))
+            live += 0 if allowed else 1
+            items.append({"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message, "allowed": allowed})
+        print(json.dumps({
+            "files": len(modules),
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "parse_s": round(parse_s, 3),
+            "rules": {k: round(v, 3) for k, v in sorted(timings.items())},
+            "findings": items,
+            "warnings": list(warnings),
+        }, indent=2))
+        return 1 if live else 0
+
     if args.stale_allows:
         if select:
             print("igloo-lint: --stale-allows runs every rule (an allow "
@@ -81,8 +116,15 @@ def main(argv=None) -> int:
         return 0
 
     t0 = time.perf_counter()
+    timings: dict = {}
     findings, warnings = run_lint(paths=paths, checkers=checkers,
-                                  select=select)
+                                  select=select, timings=timings)
+    slowest = ", ".join(
+        f"{k} {v:.2f}s" for k, v in
+        sorted(((k, v) for k, v in timings.items() if k != "(parse)"),
+               key=lambda kv: -kv[1])[:3])
+    per_rule = f"parse {timings.get('(parse)', 0.0):.2f}s; " \
+               f"slowest: {slowest}" if slowest else ""
     if not args.quiet:
         for w in warnings:
             print(f"warning: {w}", file=sys.stderr)
@@ -91,12 +133,13 @@ def main(argv=None) -> int:
             print(f.render())
         n = len(findings)
         print(f"igloo-lint: {n} finding{'s' if n != 1 else ''} "
-              f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+              f"({time.perf_counter() - t0:.1f}s; {per_rule})",
+              file=sys.stderr)
         return 1
     if not args.quiet:
         nfiles = len(paths) if paths else len(iter_package_files())
         print(f"igloo-lint: OK ({nfiles} files, "
-              f"{time.perf_counter() - t0:.1f}s)")
+              f"{time.perf_counter() - t0:.1f}s; {per_rule})")
     return 0
 
 

@@ -157,17 +157,21 @@ class LockDisciplineChecker(Checker):
 
     def __init__(self):
         self.warnings: list[str] = []
+        self._stale: list[Finding] = []       # accumulating, this run
+        self._last_stale: list[Finding] = []  # snapshot of the last run
 
     def check(self, mod: LintModule) -> Iterable[Finding]:
         guards = _load_guarded_by(mod.tree)
         if guards is None:
             return ()
+        decl_line = 1
         p = _ModulePass(guards)
         # skip the _GUARDED_BY assignment itself
         for node in mod.tree.body:
             if isinstance(node, ast.Assign) and any(
                     isinstance(t, ast.Name) and t.id == "_GUARDED_BY"
                     for t in node.targets):
+                decl_line = node.lineno
                 continue
             p.visit(node)
         for lock, names in guards.items():
@@ -175,14 +179,32 @@ class LockDisciplineChecker(Checker):
                 self.warnings.append(
                     f"lock-discipline: {mod.relpath}: declared lock "
                     f"`{lock}` never appears in a `with` block")
+                self._stale.append(Finding(
+                    "stale-entry", mod.relpath, decl_line,
+                    f"_GUARDED_BY lock `{lock}` never appears in a `with` "
+                    "block — stale declaration"))
             for n in names:
                 if n not in p.seen_names:
                     self.warnings.append(
                         f"lock-discipline: {mod.relpath}: guarded name "
                         f"`{n}` never accessed — stale declaration?")
+                    self._stale.append(Finding(
+                        "stale-entry", mod.relpath, decl_line,
+                        f"_GUARDED_BY name `{n}` is never accessed in the "
+                        "module — stale declaration"))
         return [Finding(
             RULE, mod.relpath, a.line,
             f"{a.kind} of `{a.name}` (guarded by `{p.guarded[a.name]}` per "
             "_GUARDED_BY) outside a `with` block holding the lock; hold the "
             "lock, rename the method `*_locked`, or document it caller-locked")
             for a in p.violations]
+
+    def finalize(self, modules: list) -> Iterable[Finding]:
+        # snapshot per run, like TwoPassChecker's summaries: a reused
+        # checker instance must not leak one run's staleness into the next
+        self._last_stale, self._stale = self._stale, []
+        return ()
+
+    def stale_entries(self) -> list:
+        """Structured stale-declaration report for ``--stale-allows``."""
+        return list(self._last_stale)

@@ -5,8 +5,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== igloo-lint (hazards + wire-contract / flight-actions / env-knobs) =="
-python -m igloo_tpu.lint
+echo "== igloo-lint (hazards + contracts + thread-roles / lock-order) =="
+# hard wall-time pin: the whole-program rules must not erode the "fast
+# enough to run on every commit" property (docs/static_analysis.md)
+timeout 10 python -m igloo_tpu.lint
 python -m igloo_tpu.lint --stale-allows -q
 
 echo "== ruff (lint) =="

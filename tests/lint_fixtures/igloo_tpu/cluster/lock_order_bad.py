@@ -1,0 +1,34 @@
+"""lock-order MUST-FLAG fixture: an A->B / B->A inversion across two
+functions, and a self-re-acquisition through a callee (threading.Lock is
+non-reentrant). Markers sit on the witness lines the checker reports."""
+import threading
+
+_a_lock = threading.Lock()
+_b_lock = threading.Lock()
+
+_GUARDED_BY = {"_a_lock": ("_shared_a",), "_b_lock": ("_shared_b",)}
+
+_shared_a = 0
+_shared_b = 0
+
+
+def ab_path():
+    with _a_lock:
+        with _b_lock:                # BAD: a->b here, b->a in ba_path
+            return _shared_a + _shared_b
+
+
+def ba_path():
+    with _b_lock:
+        with _a_lock:
+            return _shared_b
+
+
+def refresh():
+    with _a_lock:
+        return _recount()            # BAD: callee re-acquires _a_lock; deadlock
+
+
+def _recount():
+    with _a_lock:
+        return _shared_a

@@ -1,0 +1,32 @@
+"""lock-order MUST-NOT-FLAG twin: the same two locks, every path acquiring
+in the one order a->b (directly or through a callee) — a DAG, no cycle."""
+import threading
+
+_a_lock = threading.Lock()
+_b_lock = threading.Lock()
+
+_GUARDED_BY = {"_a_lock": ("_shared_a",), "_b_lock": ("_shared_b",)}
+
+_shared_a = 0
+_shared_b = 0
+
+
+def ab_direct():
+    with _a_lock:
+        with _b_lock:
+            return _shared_a + _shared_b
+
+
+def ab_via_callee():
+    with _a_lock:
+        return _drain_b()
+
+
+def _drain_b():
+    with _b_lock:
+        return _shared_b
+
+
+def b_alone():
+    with _b_lock:
+        return _shared_b

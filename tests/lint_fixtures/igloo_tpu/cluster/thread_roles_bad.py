@@ -1,0 +1,62 @@
+"""thread-roles MUST-FLAG fixture: every role shape the checker catalogs
+(dedicated thread, timer, pool submit, weakref finalizer) reaching
+unguarded writes. Markers sit on the WRITE lines — the finding anchor."""
+import threading
+import weakref
+
+_counts = {}
+
+
+def _record(key):
+    _counts[key] = _counts.get(key, 0) + 1   # BAD: module-global write, cross-role
+
+
+class Cache:
+    """Thread + timer roles converge on the same unguarded helper."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stats = {}
+
+    def start(self):
+        threading.Thread(target=self._refresh_loop, daemon=True).start()
+        threading.Timer(30.0, self._expire).start()
+
+    def _refresh_loop(self):
+        self._bump("refresh")
+
+    def _expire(self):
+        self._bump("expire")
+
+    def _bump(self, key):
+        self.stats[key] = self.stats.get(key, 0) + 1   # BAD: raced by thread+timer
+        _record(key)
+
+
+class Spiller:
+    """A finalizer is a role of its own: it races the drain thread."""
+
+    def __init__(self):
+        self.pending = []
+        weakref.finalize(self, self._flush)
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        self._flush()
+
+    def _flush(self):
+        self.pending = []                    # BAD: finalizer races the drain thread
+
+
+class PoolIngest:
+    """A pool-backed role is concurrent with ITSELF — one role suffices."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.rows = {}
+
+    def ingest(self, batch_id, batch):
+        self.pool.submit(self._write_rows, batch_id, batch)
+
+    def _write_rows(self, batch_id, batch):
+        self.rows[batch_id] = batch          # BAD: pool workers race each other

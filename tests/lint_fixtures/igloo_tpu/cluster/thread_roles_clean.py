@@ -1,0 +1,60 @@
+"""thread-roles MUST-NOT-FLAG twin: the same spawn shapes with every write
+covered — lexically locked, declared in _GUARDED_BY (lock-discipline owns
+the access rule then), single-role, __init__-only, or an unresolvable
+non-package callback (no role)."""
+import threading
+import weakref
+
+_GUARDED_BY = {"_lock": ("entries",)}
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}
+        self.total = 0
+
+    def start(self):
+        threading.Thread(target=self._refresh_loop, daemon=True).start()
+        threading.Timer(30.0, self._expire).start()
+
+    def _refresh_loop(self):
+        with self._lock:
+            self.total += 1          # lexical `with <lock>`: guarded
+
+    def _expire(self):
+        self._bump("expire")
+
+    def _bump(self, key):
+        with self._lock:
+            self.entries[key] = 1    # locked AND declared: lock-discipline owns it
+
+
+class Loader:
+    def __init__(self):
+        self.buf = []
+
+    def start(self):
+        threading.Thread(target=self._fill, daemon=True).start()
+
+    def _fill(self):
+        self.buf = [1]               # ONE dedicated thread role: nothing to race
+
+    def hand_off(self, permit):
+        # non-package callback: not a role (conservative resolution)
+        threading.Thread(target=permit.release, daemon=True).start()
+
+
+class Spiller:
+    def __init__(self):
+        self._spill_lock = threading.Lock()
+        self.pending = []
+        weakref.finalize(self, self._flush)
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        self._flush()
+
+    def _flush(self):
+        with self._spill_lock:
+            self.pending = []        # finalizer vs drain thread, but locked

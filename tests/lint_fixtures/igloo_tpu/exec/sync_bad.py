@@ -44,8 +44,8 @@ def jitted_result_syncs(fn, batch):
 
 def explicit_fetches(batch):
     vals = jax.device_get(batch.x)   # BAD: fetch outside a documented choke point
-    n = batch.num_live()             # BAD: num_live() is a sync by definition
-    return vals, n
+    batch.x.block_until_ready()      # BAD: explicit barrier on the hot path
+    return vals
 
 
 def suppressed_sync(batch):

@@ -1,0 +1,25 @@
+"""interprocedural sync-hazard MUST-FLAG fixture: helpers RETURN device
+values, and the callers' int()/truth-test/.item() sinks — one call away —
+light up through the collect-pass summary (the old per-function walk was
+blind to every one of these)."""
+import jax.numpy as jnp
+
+
+def _live_lane(batch):
+    return jnp.sum(batch.live)       # device value: the tainted return
+
+
+def caller_casts(batch):
+    n = _live_lane(batch)
+    return int(n)                    # BAD: helper's device return reaches int()
+
+
+class Sizer:
+    def _probe(self, lanes):
+        return jnp.max(lanes)
+
+    def estimate(self, lanes):
+        cap = self._probe(lanes)
+        if cap:                      # BAD: truth test over self-helper's return
+            return 1
+        return cap.item()            # BAD: .item() over self-helper's return

@@ -1,0 +1,24 @@
+"""interprocedural sync-hazard MUST-NOT-FLAG twin: a helper that fetches
+(inside its own allow) returns HOST data, so its callers' casts are legal;
+a device-returning helper is fine to call when nothing sinks the result."""
+import jax
+import jax.numpy as jnp
+
+
+def _live_count(batch):
+    # the helper pays its one documented readback and returns a host int
+    return int(jax.device_get(jnp.sum(batch.live)))  # lint: allow(sync-hazard)
+
+
+def caller_of_host_helper(batch):
+    n = _live_count(batch)
+    return int(n)                    # host int from the helper: no sync
+
+
+def _device_lane(batch):
+    return jnp.cumsum(batch.x)
+
+
+def caller_without_sink(batch):
+    lane = _device_lane(batch)
+    return jnp.where(lane > 0, lane, 0)   # stays on device: fine

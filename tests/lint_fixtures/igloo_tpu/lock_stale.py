@@ -1,0 +1,16 @@
+"""lock-discipline stale-declaration fixture: `_ghost_lock` is never taken
+and `phantom` never accessed — both _GUARDED_BY rows must surface as
+stale-entry findings under --stale-allows (and as warnings in a lint run)."""
+import threading
+
+_GUARDED_BY = {"_lock": ("entries",), "_ghost_lock": ("phantom",)}
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}
+
+    def put(self, key, val):
+        with self._lock:
+            self.entries[key] = val
